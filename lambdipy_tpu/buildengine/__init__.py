@@ -1,0 +1,33 @@
+"""Build engine: recipe -> pruned, smoke-tested bundle tree.
+
+The reference's build path runs recipes inside an Amazon-Linux docker
+container (SURVEY.md §3.1 #5). No docker exists here (SURVEY.md §8), so the
+engine reproduces the *procedure* of the JAX TPU image build (SURVEY.md
+§3.4: venv + pinned installs + post-build manifest) locally:
+
+- ``vendor`` backend: copy installed distributions out of the host env via
+  their RECORD file lists (the offline equivalent of ``pip install`` into
+  the build tree),
+- ``sdist`` backend: build a wheel from a local source tree (``python -m
+  build --no-isolation``) and unpack it into the bundle,
+- prune pass with the XLA/PJRT whitelist (SURVEY.md §3.3),
+- hermetic import-smoke in a fresh interpreter (SURVEY.md §5: "build ->
+  install into clean env -> import + smoke" is the integration loop).
+"""
+
+from lambdipy_tpu.buildengine.engine import BuildError, BuildResult, build_recipe
+from lambdipy_tpu.buildengine.prune import PruneReport, prune_tree, XLA_WHITELIST
+from lambdipy_tpu.buildengine.vendor import import_names, vendor_distribution
+from lambdipy_tpu.buildengine.smoke import import_smoke
+
+__all__ = [
+    "BuildError",
+    "BuildResult",
+    "build_recipe",
+    "PruneReport",
+    "prune_tree",
+    "XLA_WHITELIST",
+    "import_names",
+    "vendor_distribution",
+    "import_smoke",
+]

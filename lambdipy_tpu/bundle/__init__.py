@@ -1,0 +1,32 @@
+"""Bundle format: the deployable unit.
+
+Layout (vs. the reference's ``build/`` tree that users zip for Lambda,
+SURVEY.md §4 B — here the bundle additionally carries model params and the
+cold-start compilation cache, SURVEY.md §9.5-9.6):
+
+    bundle/
+      manifest.json     # schema, recipe, provenance, base layer, payload, files
+      site/             # pruned site-packages delta over the base layer
+      handler.py        # generated entrypoint: init(ctx) / invoke(state, req)
+      params/           # orbax checkpoint of model params (model recipes)
+      compile_cache/    # persistent XLA compilation cache, shipped warm
+"""
+
+from lambdipy_tpu.bundle.baselayer import BASE_LAYERS, base_layer_dists
+from lambdipy_tpu.bundle.format import (
+    BUNDLE_SCHEMA_VERSION,
+    BundleError,
+    load_manifest,
+    write_manifest,
+)
+from lambdipy_tpu.bundle.package import assemble_bundle
+
+__all__ = [
+    "BASE_LAYERS",
+    "BUNDLE_SCHEMA_VERSION",
+    "BundleError",
+    "assemble_bundle",
+    "base_layer_dists",
+    "load_manifest",
+    "write_manifest",
+]

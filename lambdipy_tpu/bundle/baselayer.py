@@ -1,0 +1,119 @@
+"""Shared base layers: the TPU answer to Lambda's 250 MB cap.
+
+SURVEY.md §3.3 consequence: libtpu.so alone is 614 MB and
+``jaxlib/libjax_common.so`` 308 MB, so TPU bundles cannot meet a
+Lambda-style size cap. Instead the runtime image ships a shared,
+content-addressed base layer (the analogue of AWS Lambda layers the
+reference's users attach), and per-function bundles carry only their delta.
+A base layer is a named set of distributions the runtime guarantees.
+
+At serve time the base layer resolves to the host environment's
+site-packages (this machine's /opt/venv **is** the jax-tpu base image — it
+matches ``jss:tpu/Dockerfile:43-94``'s userland, SURVEY.md §3.4). The
+manifest records the exact versions the bundle was built against so the
+runtime can detect skew.
+"""
+
+from __future__ import annotations
+
+import importlib.metadata
+import site
+import sys
+from pathlib import Path
+
+# Distribution sets per layer. Versions are recorded at build time, not here,
+# so layers stay valid across image updates (skew is detected, not assumed).
+BASE_LAYERS: dict[str, tuple[str, ...]] = {
+    "none": (),
+    # The jax TPU serving stack (jss:tpu/Dockerfile userland, SURVEY.md §3.4)
+    "jax-tpu": (
+        "jax", "jaxlib", "libtpu", "numpy", "ml-dtypes", "opt-einsum", "scipy",
+        "flax", "optax", "chex", "orbax-checkpoint", "msgpack", "einops",
+        "absl-py", "etils", "typing-extensions", "rich", "pyyaml",
+        "tensorstore", "protobuf", "treescope", "humanize", "fsspec",
+        "importlib-resources", "zipp", "nest-asyncio", "simplejson", "toolz",
+        "markdown-it-py", "mdurl", "pygments", "setuptools", "wheel",
+        "aiofiles", "ordered-set",
+    ),
+    # CPU scientific stack for configs 1-2 style bundles that opt in
+    "sci-cpu": ("numpy", "scipy", "scikit-learn", "joblib", "threadpoolctl"),
+    # torch CPU/XLA stack for config 4
+    "torch": ("torch", "numpy", "typing-extensions", "sympy", "networkx",
+              "jinja2", "markupsafe", "filelock", "fsspec", "mpmath"),
+}
+
+
+def base_layer_dists(name: str) -> set[str]:
+    try:
+        return set(BASE_LAYERS[name])
+    except KeyError:
+        raise KeyError(f"unknown base layer {name!r}; known: {sorted(BASE_LAYERS)}") from None
+
+
+def base_layer_versions(name: str) -> dict[str, str]:
+    """Installed version of each base-layer dist present on this image."""
+    out = {}
+    for dist in base_layer_dists(name):
+        try:
+            out[dist] = importlib.metadata.version(dist)
+        except importlib.metadata.PackageNotFoundError:
+            pass
+    return out
+
+
+def host_site_packages() -> list[str]:
+    """The runtime image's site-packages dirs (the physical base layer)."""
+    paths = list(site.getsitepackages()) if hasattr(site, "getsitepackages") else []
+    # fall back to deriving from a known stdlib-external module
+    if not paths:
+        import numpy
+
+        paths = [str(Path(numpy.__file__).parent.parent)]
+    return [p for p in paths if Path(p).is_dir()]
+
+
+def runtime_sys_path(bundle_site: Path, base_layer: str) -> list[str]:
+    """sys.path layering for the serve runtime: bundle delta first, then the
+    base layer (host site-packages), then the stdlib already on sys.path."""
+    path = [str(bundle_site)]
+    if base_layer != "none":
+        path.extend(host_site_packages())
+    return path
+
+
+def materialize_base_site(layer: str, dest: Path) -> Path:
+    """Build a site dir containing *exactly* the base layer, as symlinks into
+    the host env. Used by the build smoke so a base-layer recipe is tested
+    against the declared layer contents, not the whole host site-packages
+    (which would mask missing vendored files)."""
+    import importlib.metadata as md
+
+    dest = Path(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+    for dist_name in base_layer_dists(layer):
+        try:
+            dist = md.distribution(dist_name)
+        except md.PackageNotFoundError:
+            continue
+        tops: set[str] = set()
+        for f in dist.files or []:
+            first = Path(str(f)).parts[0] if Path(str(f)).parts else ""
+            if first and first != "..":
+                tops.add(first)
+        for top in tops:
+            src = Path(dist.locate_file(top))
+            link = dest / top
+            if src.exists() and not link.exists():
+                link.symlink_to(src)
+    return dest
+
+
+def check_skew(manifest_versions: dict[str, str], layer: str) -> dict[str, tuple[str, str]]:
+    """Compare bundle-recorded base-layer versions with the live image.
+    Returns {dist: (built_against, live)} for mismatches."""
+    live = base_layer_versions(layer)
+    return {
+        dist: (want, live.get(dist, "<absent>"))
+        for dist, want in manifest_versions.items()
+        if live.get(dist) != want
+    }

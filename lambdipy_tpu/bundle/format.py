@@ -1,0 +1,82 @@
+"""Bundle manifest: schema, writer, loader, verifier.
+
+The manifest is the bundle's single source of truth — provenance (the
+pattern of the TPU image exemplar's post-build manifest, SURVEY.md §3.4),
+base-layer contract, payload description, and a per-file content-hash list
+used for integrity checks and registry dedup.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from lambdipy_tpu.utils.fsutil import atomic_write_text, hash_file, walk_files
+
+BUNDLE_SCHEMA_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+
+class BundleError(RuntimeError):
+    pass
+
+
+def file_table(bundle_dir: Path) -> list[dict]:
+    bundle_dir = Path(bundle_dir)
+    table = []
+    for path in walk_files(bundle_dir):
+        rel = path.relative_to(bundle_dir).as_posix()
+        if rel == MANIFEST_NAME or not path.is_file():
+            continue  # is_file() is False for dangling symlinks
+        table.append({
+            "path": rel,
+            "size": path.stat().st_size,
+            "hash": hash_file(path),
+        })
+    return table
+
+
+def write_manifest(bundle_dir: Path, *, artifact_id: str, provenance: dict,
+                   base_layer: dict, payload: dict | None,
+                   runtime: dict | None = None) -> dict:
+    bundle_dir = Path(bundle_dir)
+    manifest = {
+        "schema": BUNDLE_SCHEMA_VERSION,
+        "artifact_id": artifact_id,
+        "provenance": provenance,
+        "base_layer": base_layer,
+        "payload": payload,
+        "runtime": runtime or {},
+        "files": file_table(bundle_dir),
+    }
+    atomic_write_text(bundle_dir / MANIFEST_NAME,
+                      json.dumps(manifest, indent=1, sort_keys=True))
+    return manifest
+
+
+def load_manifest(bundle_dir: Path) -> dict:
+    path = Path(bundle_dir) / MANIFEST_NAME
+    if not path.exists():
+        raise BundleError(f"{bundle_dir} is not a bundle (no {MANIFEST_NAME})")
+    manifest = json.loads(path.read_text())
+    if manifest.get("schema") != BUNDLE_SCHEMA_VERSION:
+        raise BundleError(
+            f"unsupported bundle schema {manifest.get('schema')!r} in {bundle_dir}")
+    return manifest
+
+
+def verify_files(bundle_dir: Path, manifest: dict | None = None) -> list[str]:
+    """Integrity check: returns a list of problems (empty = ok)."""
+    bundle_dir = Path(bundle_dir)
+    manifest = manifest or load_manifest(bundle_dir)
+    problems = []
+    for entry in manifest["files"]:
+        path = bundle_dir / entry["path"]
+        if not path.is_file():
+            problems.append(f"missing: {entry['path']}")
+            continue
+        if path.stat().st_size != entry["size"]:
+            problems.append(f"size mismatch: {entry['path']}")
+        elif hash_file(path) != entry["hash"]:
+            problems.append(f"hash mismatch: {entry['path']}")
+    return problems

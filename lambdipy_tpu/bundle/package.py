@@ -1,0 +1,121 @@
+"""Bundle assembly: build result + payload -> deployable bundle dir.
+
+The analogue of the reference's ``lambdipy package`` step (SURVEY.md §4 B:
+assemble build/ tree + pip-install plain deps), extended with the TPU
+payload materialization of SURVEY.md §9.5: model params saved as an orbax
+checkpoint inside the bundle, a generated ``handler.py``, and (optionally) a
+warmed persistent XLA compilation cache so cold start skips the first
+compile.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # avoid circular import: buildengine.engine uses baselayer
+    from lambdipy_tpu.buildengine.engine import BuildResult
+
+from lambdipy_tpu.buildengine.vendor import vendor_distribution
+from lambdipy_tpu.bundle.baselayer import base_layer_versions
+from lambdipy_tpu.bundle.format import write_manifest
+from lambdipy_tpu.recipes.schema import Recipe
+from lambdipy_tpu.utils.fsutil import copy_tree
+from lambdipy_tpu.utils.logs import get_logger, log_event
+
+log = get_logger("lambdipy.package")
+
+_HANDLER_TEMPLATE = '''\
+"""Generated bundle entrypoint ({recipe}).
+
+The serve runtime imports this module with the bundle site tree and base
+layer on sys.path, calls ``init(ctx)`` once at boot (cold start), then
+``invoke(state, request)`` per request.
+"""
+
+from {module} import {attr} as _build_handler
+
+_SPEC = {spec!r}
+
+
+def init(ctx):
+    return _build_handler(_SPEC, ctx)
+
+
+def invoke(state, request):
+    return state.invoke(request)
+'''
+
+
+def materialize_payload(recipe: Recipe, bundle_dir: Path) -> dict:
+    """Write the model payload into the bundle: generated handler.py and,
+    for params="init", an orbax checkpoint of randomly initialized params
+    (no weight-download path exists offline — SURVEY.md §8; real deployments
+    pass a checkpoint path in payload.params)."""
+    payload = recipe.payload
+    assert payload is not None
+    module, attr = payload.handler.split(":", 1)
+    spec = {
+        "recipe": recipe.name,
+        "model": payload.model,
+        "params": payload.params,
+        "dtype": payload.dtype,
+        "batch_size": payload.batch_size,
+        "mesh": payload.mesh_dict(),
+        "quant": payload.quant,
+        "extra": dict(payload.extra),
+        "device": recipe.device,
+    }
+    handler_py = _HANDLER_TEMPLATE.format(
+        recipe=recipe.name, module=module, attr=attr, spec=spec)
+    (Path(bundle_dir) / "handler.py").write_text(handler_py)
+
+    manifest_payload = dict(spec)
+    if payload.params == "init" and payload.model not in ("hello",):
+        from lambdipy_tpu.models import registry as model_registry
+
+        params_dir = Path(bundle_dir) / "params"
+        info = model_registry.save_init_params(
+            payload.model, params_dir, dtype=payload.dtype, quant=payload.quant)
+        manifest_payload["params"] = "params"
+        manifest_payload["params_info"] = info
+    return manifest_payload
+
+
+def assemble_bundle(result: "BuildResult", out_dir: Path, *,
+                    plain_deps: list[str] | None = None,
+                    with_payload: bool = True) -> dict:
+    """Assemble the final bundle tree and write its manifest.
+
+    ``plain_deps``: non-recipe project deps vendored straight into site/
+    (the reference's "pip-install remaining deps into build/" step).
+    Returns the manifest dict.
+    """
+    recipe = result.recipe
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    site_dst = out_dir / "site"
+    if result.site_dir.resolve() != site_dst.resolve():
+        copy_tree(result.site_dir, site_dst)
+    for dep in plain_deps or []:
+        result.vendored.append(vendor_distribution(dep, site_dst))
+
+    manifest_payload = None
+    if with_payload and recipe.is_model:
+        manifest_payload = materialize_payload(recipe, out_dir)
+
+    manifest = write_manifest(
+        out_dir,
+        artifact_id=recipe.artifact_id(f"{sys.version_info.major}.{sys.version_info.minor}"),
+        provenance=result.provenance(),
+        base_layer={
+            "name": recipe.base_layer,
+            "versions": base_layer_versions(recipe.base_layer),
+        },
+        payload=manifest_payload,
+        runtime={"entry": "handler.py"} if recipe.is_model else {},
+    )
+    log_event(log, "bundle assembled", recipe=recipe.name, out=str(out_dir),
+              files=len(manifest["files"]))
+    return manifest

@@ -1,0 +1,30 @@
+"""Recipe store: per-package build recipes with TPU device variants.
+
+The reference keeps in-repo recipe definitions per heavy package (supported
+versions, build steps, prune rules; SURVEY.md §3.1 component #3). Here a
+recipe is a validated TOML file under ``lambdipy_tpu/recipes/builtin/``;
+model recipes additionally declare a JAX payload (model + params + handler).
+"""
+
+from lambdipy_tpu.recipes.schema import (
+    BuildSpec,
+    PayloadSpec,
+    PruneSpec,
+    Recipe,
+    RecipeError,
+    load_recipe_file,
+    load_recipe_dict,
+)
+from lambdipy_tpu.recipes.store import RecipeStore, builtin_store
+
+__all__ = [
+    "BuildSpec",
+    "PayloadSpec",
+    "PruneSpec",
+    "Recipe",
+    "RecipeError",
+    "RecipeStore",
+    "builtin_store",
+    "load_recipe_file",
+    "load_recipe_dict",
+]

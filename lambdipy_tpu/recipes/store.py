@@ -1,0 +1,57 @@
+"""Recipe store: discovery and lookup of recipe documents.
+
+Mirrors the reference's in-repo recipe directory (SURVEY.md §3.1 #3) —
+builtin recipes live as TOML files in ``lambdipy_tpu/recipes/builtin/``;
+additional stores (a project-local ``recipes/`` dir) can be layered on top.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from pathlib import Path
+
+from lambdipy_tpu.recipes.schema import Recipe, RecipeError, load_recipe_file
+
+BUILTIN_DIR = Path(__file__).parent / "builtin"
+
+
+class RecipeStore:
+    def __init__(self, dirs: list[Path]):
+        self._dirs = [Path(d) for d in dirs]
+        self._recipes: dict[str, Recipe] = {}
+        for d in self._dirs:
+            if not d.is_dir():
+                continue
+            for path in sorted(d.glob("*.toml")):
+                recipe = load_recipe_file(path)
+                # later dirs override earlier ones (project overrides builtin)
+                self._recipes[recipe.name] = recipe
+
+    def names(self) -> list[str]:
+        return sorted(self._recipes)
+
+    def get(self, name: str) -> Recipe:
+        try:
+            return self._recipes[name]
+        except KeyError:
+            raise RecipeError(
+                f"no recipe named {name!r}; available: {', '.join(self.names())}"
+            ) from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._recipes
+
+    def covering(self, package: str) -> Recipe | None:
+        """Recipe covering a plain pip package name, if any (used by the
+        resolver to split recipe-covered vs plain deps, SURVEY.md §4 A)."""
+        from packaging.utils import canonicalize_name
+
+        return self._recipes.get(canonicalize_name(package))
+
+
+@lru_cache(maxsize=None)
+def builtin_store(extra_dir: str | None = None) -> RecipeStore:
+    dirs = [BUILTIN_DIR]
+    if extra_dir:
+        dirs.append(Path(extra_dir))
+    return RecipeStore(dirs)

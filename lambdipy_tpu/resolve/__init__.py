@@ -1,0 +1,31 @@
+"""Project resolution + artifact/source indexes.
+
+The reference resolves a project's requirements.txt / Pipfile into a pinned
+package list, splits it into recipe-covered vs plain deps, and matches the
+recipe-covered set against prebuilt artifacts on GitHub Releases (SURVEY.md
+§3.1 #2/#4, §4 A). This environment has no network, so the release index
+becomes a local content-addressed artifact registry and sources come from a
+local source store.
+"""
+
+from lambdipy_tpu.resolve.requirements import (
+    Requirement,
+    ResolutionError,
+    parse_requirement,
+    parse_requirements_text,
+    resolve_project,
+    split_by_recipes,
+)
+from lambdipy_tpu.resolve.registry import ArtifactRegistry
+from lambdipy_tpu.resolve.sources import SourceStore
+
+__all__ = [
+    "ArtifactRegistry",
+    "Requirement",
+    "ResolutionError",
+    "SourceStore",
+    "parse_requirement",
+    "parse_requirements_text",
+    "resolve_project",
+    "split_by_recipes",
+]

@@ -1,0 +1,127 @@
+"""Requirements parsing and recipe-aware resolution.
+
+Parses PEP-508 requirement lines (via :mod:`packaging`) from requirements.txt
+content, pins them against the locally installed distribution set (the
+offline stand-in for PyPI resolution — SURVEY.md §8: no network; §2 table:
+"resolve against local wheel store"), and splits the pinned list into
+recipe-covered vs plain deps exactly as the reference's resolver does
+(SURVEY.md §4 call stack A).
+"""
+
+from __future__ import annotations
+
+import importlib.metadata
+from dataclasses import dataclass
+from pathlib import Path
+
+from packaging.requirements import InvalidRequirement
+from packaging.requirements import Requirement as _PepRequirement
+from packaging.utils import canonicalize_name
+from packaging.version import Version
+
+from lambdipy_tpu.recipes.store import RecipeStore
+
+
+class ResolutionError(ValueError):
+    """Raised when a requirement cannot be parsed or satisfied locally."""
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """A parsed requirement, optionally pinned to a locally available version."""
+
+    name: str  # canonical (lowercase, dash) name
+    raw: str  # original line
+    specifier: str  # e.g. "==2.0.2", may be ""
+    pinned: str | None = None  # resolved exact version
+
+    @property
+    def pin(self) -> str:
+        if self.pinned is None:
+            raise ResolutionError(f"requirement {self.raw!r} is not pinned")
+        return f"{self.name}=={self.pinned}"
+
+
+def parse_requirement(line: str) -> Requirement:
+    try:
+        pep = _PepRequirement(line)
+    except InvalidRequirement as e:
+        raise ResolutionError(f"invalid requirement {line!r}: {e}") from e
+    return Requirement(
+        name=canonicalize_name(pep.name),
+        raw=line,
+        specifier=str(pep.specifier),
+    )
+
+
+def parse_requirements_text(text: str) -> list[Requirement]:
+    """Parse requirements.txt content: one requirement per line, ``#``
+    comments and blank lines skipped, pip option lines (-r/-e/--hash...)
+    rejected explicitly rather than misparsed."""
+    out: list[Requirement] = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if line.startswith("-"):
+            raise ResolutionError(
+                f"line {lineno}: pip option lines ({line.split()[0]}) are not supported"
+            )
+        out.append(parse_requirement(line))
+    return out
+
+
+def installed_version(name: str) -> str | None:
+    try:
+        return importlib.metadata.version(name)
+    except importlib.metadata.PackageNotFoundError:
+        return None
+
+
+def pin_against_local(req: Requirement) -> Requirement:
+    """Pin a requirement against the locally installed distribution set.
+
+    This is the offline resolver: the local env *is* the wheel store. A
+    version conflict (installed version outside the specifier) is an error,
+    matching the reference's behavior when no release asset matches.
+    """
+    version = installed_version(req.name)
+    if version is None:
+        raise ResolutionError(
+            f"requirement {req.raw!r}: distribution {req.name!r} is not available "
+            "in the local wheel store (offline environment)"
+        )
+    pep = _PepRequirement(req.raw)
+    if req.specifier and not pep.specifier.contains(Version(version), prereleases=True):
+        raise ResolutionError(
+            f"requirement {req.raw!r} cannot be satisfied: local store has "
+            f"{req.name}=={version}"
+        )
+    return Requirement(name=req.name, raw=req.raw, specifier=req.specifier, pinned=version)
+
+
+@dataclass(frozen=True)
+class ProjectResolution:
+    """Result of resolving a project: recipe-covered deps build via recipes,
+    plain deps are vendored directly at package time (SURVEY.md §4 B)."""
+
+    recipe_covered: tuple[tuple[Requirement, str], ...]  # (req, recipe name)
+    plain: tuple[Requirement, ...]
+
+
+def split_by_recipes(reqs: list[Requirement], store: RecipeStore) -> ProjectResolution:
+    covered: list[tuple[Requirement, str]] = []
+    plain: list[Requirement] = []
+    for req in reqs:
+        recipe = store.covering(req.name)
+        if recipe is not None:
+            covered.append((req, recipe.name))
+        else:
+            plain.append(req)
+    return ProjectResolution(recipe_covered=tuple(covered), plain=tuple(plain))
+
+
+def resolve_project(requirements_path: Path, store: RecipeStore) -> ProjectResolution:
+    reqs = parse_requirements_text(Path(requirements_path).read_text())
+    pinned = [pin_against_local(r) for r in reqs]
+    return split_by_recipes(pinned, store)

@@ -1,0 +1,69 @@
+"""Filesystem helpers used by the build engine, prune pass and bundle store.
+
+Hashing prefers the native C extension (:mod:`lambdipy_tpu._native`) when it
+has been built (``python setup_native.py build_ext --inplace``); otherwise it
+falls back to :mod:`hashlib`. Bundle manifests record a content hash per file
+(the provenance pattern of the TPU base-image exemplar's post-build manifest,
+SURVEY.md §3.4 ``jss:generate_manifest.sh``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from collections.abc import Iterator
+from pathlib import Path
+
+_CHUNK = 1 << 20
+
+
+def walk_files(root: Path) -> Iterator[Path]:
+    """Yield all regular files under root (sorted, deterministic)."""
+    root = Path(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = Path(dirpath) / name
+            if p.is_file() or p.is_symlink():
+                yield p
+
+
+def sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while chunk := f.read(_CHUNK):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _native_hasher():
+    try:
+        from lambdipy_tpu import _native  # C extension, optional
+
+        return _native.xxh64_file
+    except Exception:
+        return None
+
+
+def hash_file(path: Path) -> str:
+    """Fast content hash for manifests: native xxh64 when built, sha256 otherwise."""
+    native = _native_hasher()
+    if native is not None:
+        return f"xxh64:{native(str(path)):016x}"
+    return f"sha256:{sha256_file(path)}"
+
+
+def dir_size(root: Path) -> int:
+    return sum(p.stat().st_size for p in walk_files(root) if p.is_file())
+
+
+def copy_tree(src: Path, dst: Path, *, symlinks: bool = True) -> None:
+    shutil.copytree(src, dst, symlinks=symlinks, dirs_exist_ok=True)
+
+
+def atomic_write_text(path: Path, text: str) -> None:
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)

@@ -1,0 +1,47 @@
+"""Structured JSON logging.
+
+The reference logs via plain ``click.echo`` to stdout (SURVEY.md §6
+metrics/logging row). The rebuild emits one JSON object per line so the
+serve runtime's logs are machine-parseable (invoke latencies, cold-start
+stages, build provenance).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        extra = getattr(record, "data", None)
+        if isinstance(extra, dict):
+            payload.update(extra)
+        return json.dumps(payload, default=str)
+
+
+def get_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        if os.environ.get("LAMBDIPY_LOG_FORMAT", "json") == "json":
+            handler.setFormatter(_JsonFormatter())
+        else:
+            handler.setFormatter(logging.Formatter("%(levelname)s %(name)s: %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("LAMBDIPY_LOG_LEVEL", "INFO").upper())
+        logger.propagate = False
+    return logger
+
+
+def log_event(logger: logging.Logger, msg: str, **data) -> None:
+    logger.info(msg, extra={"data": data})

@@ -1,0 +1,53 @@
+"""Timing helpers.
+
+The serve path's cold-start budget (<10 s, BASELINE.md) is consumed almost
+entirely by interpreter + PJRT init + first compile, so every stage of boot
+and build is timed with :class:`StageTimer` and reported in structured logs.
+Mirrors the per-stage timing the build engine needs (SURVEY.md §6 tracing
+row: the reference has none; the rebuild makes it first-class).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Timer:
+    """Monotonic stopwatch."""
+
+    start: float = field(default_factory=time.monotonic)
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.start
+
+    def lap(self) -> float:
+        now = time.monotonic()
+        out = now - self.start
+        self.start = now
+        return out
+
+
+@dataclass
+class StageTimer:
+    """Accumulates named stage durations; used for cold-start breakdowns."""
+
+    stages: dict[str, float] = field(default_factory=dict)
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.stages[name] = self.stages.get(name, 0.0) + (time.monotonic() - t0)
+
+    def total(self) -> float:
+        return sum(self.stages.values())
+
+    def report(self) -> dict[str, float]:
+        out = {k: round(v, 4) for k, v in self.stages.items()}
+        out["total"] = round(self.total(), 4)
+        return out

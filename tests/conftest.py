@@ -1,0 +1,36 @@
+"""Test configuration.
+
+Runs the suite on a virtual 8-device CPU mesh (SURVEY.md §5.4): multi-chip
+mesh/pjit/collective logic is exercised without TPU hardware and the same
+code runs unmodified on a real slice. Environment must be set before jax is
+first imported, hence the module-level assignments here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# keep test compiles fast and deterministic
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_registry(tmp_path):
+    from lambdipy_tpu.resolve.registry import ArtifactRegistry
+
+    return ArtifactRegistry(tmp_path / "registry")
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"
+    return devices

@@ -1,0 +1,143 @@
+"""Build engine integration tests: vendor + closure + sdist + smoke + bundle
+(SURVEY.md §5 plan item 2: hermetic integration against the local stores)."""
+
+import json
+
+import pytest
+
+from lambdipy_tpu.buildengine import build_recipe, import_names, import_smoke
+from lambdipy_tpu.buildengine.engine import BuildError
+from lambdipy_tpu.buildengine.smoke import SmokeError
+from lambdipy_tpu.buildengine.vendor import (
+    VendorError,
+    dependency_closure,
+    find_distribution,
+    vendor_distribution,
+)
+from lambdipy_tpu.bundle import assemble_bundle, load_manifest
+from lambdipy_tpu.bundle.format import verify_files
+from lambdipy_tpu.recipes.schema import load_recipe_dict
+
+
+def test_vendor_small_distribution(tmp_path):
+    rec = vendor_distribution("click", tmp_path / "site")
+    assert rec["name"] == "click" and rec["files"] > 0
+    assert (tmp_path / "site" / "click" / "__init__.py").exists()
+    versions = import_smoke(tmp_path / "site", ["click"])
+    assert "click" in versions
+
+
+def test_vendor_missing_raises(tmp_path):
+    with pytest.raises(VendorError, match="not installed"):
+        vendor_distribution("not-a-real-pkg-xyz", tmp_path)
+
+
+def test_import_names_mapping():
+    assert "sklearn" in import_names(find_distribution("scikit-learn"))
+
+
+def test_dependency_closure_follows_requires():
+    closure = dependency_closure(["flax"])
+    assert "jax" in closure and "numpy" in closure and "msgpack" in closure
+
+
+def test_dependency_closure_extras():
+    base = dependency_closure(["jax"])
+    tpu = dependency_closure(["jax[tpu]"])
+    assert "jaxlib" in base
+    assert "libtpu" in tpu  # extra-gated dep followed
+
+
+def test_smoke_fails_on_broken_tree(tmp_path):
+    site = tmp_path / "site"
+    (site / "brokenpkg").mkdir(parents=True)
+    (site / "brokenpkg" / "__init__.py").write_text("import missing_dep_xyz\n")
+    with pytest.raises(SmokeError, match="missing_dep_xyz"):
+        import_smoke(site, ["brokenpkg"])
+
+
+def _fake_recipe(**over):
+    doc = {
+        "schema": 1,
+        "name": "clicky",
+        "version": "1.0",
+        "requires": ["click>=8"],
+        "prune": {"rules": ["tests", "pycache", "dist-info-extras"]},
+    }
+    doc.update(over)
+    return load_recipe_dict(doc)
+
+
+def test_build_vendor_recipe_end_to_end(tmp_path):
+    result = build_recipe(_fake_recipe(), tmp_path / "work")
+    assert result.smoke_versions.get("click")
+    assert result.prune.bytes_after > 0
+    prov = result.provenance()
+    assert prov["recipe"] == "clicky"
+    assert {"stage", "prune", "smoke", "total"} <= set(prov["timings"])
+
+
+def test_build_missing_required_dist_raises(tmp_path):
+    recipe = _fake_recipe(requires=["definitely-not-installed-xyz"])
+    with pytest.raises(BuildError, match="not installed"):
+        build_recipe(recipe, tmp_path / "work")
+
+
+def test_build_optional_skip_recorded(tmp_path):
+    recipe = _fake_recipe(optional_requires=["definitely-not-installed-xyz"])
+    result = build_recipe(recipe, tmp_path / "work")
+    assert result.skipped_optional == ["definitely-not-installed-xyz"]
+
+
+def test_base_layer_subtraction(tmp_path):
+    """With numpy in the base layer, a numpy-requiring recipe vendors nothing
+    numpy-shaped into the delta."""
+    recipe = load_recipe_dict({
+        "schema": 1, "name": "thin", "version": "1",
+        "requires": ["numpy"], "base_layer": "sci-cpu",
+    })
+    result = build_recipe(recipe, tmp_path / "work")
+    assert not (tmp_path / "work" / "site" / "numpy").exists()
+    assert result.smoke_versions.get("numpy")  # still importable via base layer
+
+
+def test_assemble_bundle_manifest_and_verify(tmp_path):
+    result = build_recipe(_fake_recipe(), tmp_path / "work")
+    out = tmp_path / "bundle"
+    manifest = assemble_bundle(result, out, with_payload=False)
+    loaded = load_manifest(out)
+    assert loaded["artifact_id"] == manifest["artifact_id"]
+    assert loaded["base_layer"]["name"] == "none"
+    assert verify_files(out) == []
+    # corrupt a file -> verify catches it
+    victim = next(f for f in loaded["files"] if f["path"].endswith(".py"))
+    (out / victim["path"]).write_text("tampered\n")
+    assert any("mismatch" in p for p in verify_files(out))
+
+
+def test_plain_deps_vendored_at_package_time(tmp_path):
+    result = build_recipe(_fake_recipe(), tmp_path / "work")
+    out = tmp_path / "bundle"
+    assemble_bundle(result, out, plain_deps=["einops"], with_payload=False)
+    assert (out / "site" / "einops" / "__init__.py").exists()
+
+
+@pytest.mark.slow
+def test_certifi_sdist_build_end_to_end(tmp_path):
+    """The trivial-recipe exemplar: build certifi from its local source
+    archive through the sandbox wheel path (SURVEY.md §5 verified exemplar)."""
+    from lambdipy_tpu.recipes import builtin_store
+    from lambdipy_tpu.resolve.sources import SourceStore
+
+    store = SourceStore(cache=tmp_path / "srccache")
+    try:
+        store.resolve("certifi")
+    except Exception as e:
+        pytest.skip(f"certifi source unavailable: {e}")
+    recipe = builtin_store().get("certifi")
+    result = build_recipe(recipe, tmp_path / "work", sources=store)
+    assert (tmp_path / "work" / "site" / "certifi" / "cacert.pem").exists()
+    assert result.smoke_versions.get("certifi")
+    out = tmp_path / "bundle"
+    manifest = assemble_bundle(result, out, with_payload=False)
+    assert json.dumps(manifest)  # serializable

@@ -1,0 +1,85 @@
+"""Recipe schema + store tests (SURVEY.md §5 rebuild test plan, item 1)."""
+
+import pytest
+
+from lambdipy_tpu.recipes import (
+    Recipe,
+    RecipeError,
+    builtin_store,
+    load_recipe_dict,
+    load_recipe_file,
+)
+from lambdipy_tpu.recipes.store import BUILTIN_DIR, RecipeStore
+
+
+def test_builtin_recipes_all_load_and_validate():
+    store = builtin_store()
+    names = store.names()
+    # the five baseline configs + package exemplars must be covered
+    for expected in ["certifi", "numpy", "hello-numpy", "tabular-sklearn",
+                     "jax-resnet50", "jax-bert", "torch-xla-bert", "jax-llama3-8b"]:
+        assert expected in names, f"missing builtin recipe {expected}"
+    for name in names:
+        recipe = store.get(name)
+        assert isinstance(recipe, Recipe)
+        assert recipe.version
+
+
+def test_model_recipes_have_payloads():
+    store = builtin_store()
+    for name in ["jax-resnet50", "jax-bert", "jax-llama3-8b", "hello-numpy"]:
+        assert store.get(name).is_model
+    assert not store.get("numpy").is_model
+    llama = store.get("jax-llama3-8b")
+    assert llama.payload.mesh_dict() == {"dp": 1, "tp": 4}
+    assert llama.payload.quant == "int8"
+    assert llama.device == "tpu-v5e-4"
+
+
+def test_artifact_id_naming():
+    r = builtin_store().get("jax-resnet50")
+    assert r.artifact_id("3.12") == "jax-resnet50-1.0.0-py312-tpu-v5e-1"
+
+
+def test_unknown_keys_rejected():
+    with pytest.raises(RecipeError, match="unknown recipe keys"):
+        load_recipe_dict({"name": "x", "version": "1", "bogus": True})
+
+
+def test_bad_device_rejected():
+    with pytest.raises(RecipeError, match="unknown device"):
+        load_recipe_dict({"name": "x", "version": "1", "device": "gpu-h100"})
+
+
+def test_sdist_requires_source():
+    with pytest.raises(RecipeError, match="sdist build needs build.source"):
+        load_recipe_dict({"name": "x", "version": "1", "build": {"backend": "sdist"}})
+
+
+def test_payload_handler_format_enforced():
+    with pytest.raises(RecipeError, match="module:attr"):
+        load_recipe_dict({
+            "name": "x", "version": "1",
+            "payload": {"model": "m", "handler": "no_colon_here"},
+        })
+
+
+def test_invalid_toml_reported_with_path(tmp_path):
+    p = tmp_path / "bad.toml"
+    p.write_text("name = [unclosed")
+    with pytest.raises(RecipeError, match="invalid TOML"):
+        load_recipe_file(p)
+
+
+def test_project_store_overrides_builtin(tmp_path):
+    (tmp_path / "numpy.toml").write_text(
+        'schema = 1\nname = "numpy"\nversion = "9.9.9"\n'
+    )
+    store = RecipeStore([BUILTIN_DIR, tmp_path])
+    assert store.get("numpy").version == "9.9.9"
+
+
+def test_covering_canonicalizes_name():
+    store = builtin_store()
+    assert store.covering("NumPy") is not None
+    assert store.covering("nonexistent-pkg") is None

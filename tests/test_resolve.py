@@ -1,0 +1,96 @@
+"""Resolver + registry tests (SURVEY.md §5 rebuild test plan, items 1-2)."""
+
+import pytest
+
+from lambdipy_tpu.recipes import builtin_store
+from lambdipy_tpu.resolve import (
+    ResolutionError,
+    parse_requirements_text,
+    resolve_project,
+    split_by_recipes,
+)
+from lambdipy_tpu.resolve.requirements import pin_against_local
+from lambdipy_tpu.resolve.registry import ArtifactRegistry, RegistryError
+
+
+def test_parse_requirements_basic():
+    reqs = parse_requirements_text(
+        "numpy==2.0.2\n# comment\n\nscipy>=1.0  # inline comment\nclick\n"
+    )
+    assert [r.name for r in reqs] == ["numpy", "scipy", "click"]
+    assert reqs[0].specifier == "==2.0.2"
+    assert reqs[2].specifier == ""
+
+
+def test_parse_rejects_pip_options():
+    with pytest.raises(ResolutionError, match="option lines"):
+        parse_requirements_text("-r other.txt\n")
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ResolutionError, match="invalid requirement"):
+        parse_requirements_text("not a requirement!!!\n")
+
+
+def test_pin_against_local_env():
+    req = parse_requirements_text("numpy>=2.0\n")[0]
+    pinned = pin_against_local(req)
+    assert pinned.pinned is not None
+    assert pinned.pin.startswith("numpy==")
+
+
+def test_pin_conflict_raises():
+    req = parse_requirements_text("numpy==0.0.1\n")[0]
+    with pytest.raises(ResolutionError, match="cannot be satisfied"):
+        pin_against_local(req)
+
+
+def test_pin_missing_distribution_raises():
+    req = parse_requirements_text("surely-not-installed-pkg\n")[0]
+    with pytest.raises(ResolutionError, match="not available"):
+        pin_against_local(req)
+
+
+def test_split_by_recipes():
+    store = builtin_store()
+    reqs = parse_requirements_text("numpy==2.0.2\nclick>=8\n")
+    res = split_by_recipes(reqs, store)
+    assert [(r.name, name) for r, name in res.recipe_covered] == [("numpy", "numpy")]
+    assert [r.name for r in res.plain] == ["click"]
+
+
+def test_resolve_project_end_to_end(tmp_path):
+    req_file = tmp_path / "requirements.txt"
+    req_file.write_text("numpy>=2.0\nclick\n")
+    res = resolve_project(req_file, builtin_store())
+    (req, recipe_name), = res.recipe_covered
+    assert recipe_name == "numpy" and req.pinned
+    assert res.plain[0].pinned  # plain deps are pinned too
+
+
+def test_registry_publish_fetch_roundtrip(tmp_registry, tmp_path):
+    bundle = tmp_path / "bundle"
+    (bundle / "site").mkdir(parents=True)
+    (bundle / "site" / "mod.py").write_text("x = 1\n")
+    tmp_registry.publish("pkg-1.0-py312-cpu", bundle, recipe="pkg",
+                         version="1.0", device="cpu", manifest={"k": "v"})
+    assert tmp_registry.has("pkg-1.0-py312-cpu")
+    fetched = tmp_registry.fetch("pkg-1.0-py312-cpu")
+    assert (fetched / "site" / "mod.py").read_text() == "x = 1\n"
+    infos = tmp_registry.list()
+    assert infos[0].recipe == "pkg" and infos[0].size_bytes > 0
+
+
+def test_registry_fetch_missing_raises(tmp_registry):
+    with pytest.raises(RegistryError):
+        tmp_registry.fetch("nope")
+
+
+def test_registry_delete(tmp_registry, tmp_path):
+    bundle = tmp_path / "b"
+    bundle.mkdir()
+    (bundle / "f").write_text("x")
+    tmp_registry.publish("a-1", bundle, recipe="a", version="1", device="cpu")
+    tmp_registry.delete("a-1")
+    assert not tmp_registry.has("a-1")
+    assert tmp_registry.list() == []
