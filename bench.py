@@ -1,0 +1,67 @@
+"""Driver benchmark: flagship serving latency on the real chip.
+
+Measures ResNet-50 bf16 batch-1 forward p50 on the attached TPU (the
+BASELINE.json north-star metric: <15 ms p50 on v5e-1) and prints ONE JSON
+line. ``vs_baseline`` is the speedup vs the 15 ms target (>1 = beating it).
+
+Run with the shell's default env (JAX_PLATFORMS=axon -> the real chip);
+falls back to whatever backend initializes (and reports which) so the
+benchmark never crashes outright on a CPU-only machine.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+BASELINE_P50_MS = 15.0  # BASELINE.json north star for ResNet-50 on v5e-1
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    import jax
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models import registry
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    init_s = time.monotonic() - t0
+
+    adapter = registry.get("resnet50").build(dtype="bfloat16")
+    params = adapter.init_params(seed=0, batch_size=1)
+    x = jnp.zeros((1, 224, 224, 3), jnp.bfloat16)
+    fwd = jax.jit(adapter.forward)
+
+    t1 = time.monotonic()
+    jax.block_until_ready(fwd(params, x))
+    compile_s = time.monotonic() - t1
+
+    # warmup then timed p50
+    for _ in range(5):
+        jax.block_until_ready(fwd(params, x))
+    times = []
+    iters = 50 if platform != "cpu" else 10
+    for _ in range(iters):
+        t = time.monotonic()
+        jax.block_until_ready(fwd(params, x))
+        times.append((time.monotonic() - t) * 1000.0)
+    p50 = statistics.median(times)
+
+    print(json.dumps({
+        "metric": "resnet50_b1_fwd_p50",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_P50_MS / p50, 3),
+        "platform": platform,
+        "n_devices": len(devices),
+        "init_s": round(init_s, 2),
+        "first_compile_s": round(compile_s, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

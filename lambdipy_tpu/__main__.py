@@ -1,0 +1,3 @@
+from lambdipy_tpu.cli import main
+
+main()

@@ -1,0 +1,238 @@
+"""CLI: ``build / package / deploy / serve / invoke`` + stores admin.
+
+Same command surface shape as the reference's click CLI (SURVEY.md §3.1
+#1: ``lambdipy build`` / ``lambdipy package``), extended with the serve-side
+commands the TPU rebuild adds (deploy/serve/invoke/stop — SURVEY.md §2
+table, publish/deploy row). End state per BASELINE.json:
+``lambdipy build jax-resnet50 && lambdipy deploy jax-resnet50``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+import click
+
+from lambdipy_tpu.utils.logs import get_logger
+
+log = get_logger("lambdipy.cli")
+
+
+@click.group()
+def main():
+    """lambdipy-tpu: TPU-native serverless bundle framework."""
+
+
+# -- recipe/registry admin --------------------------------------------------
+
+
+@main.command("recipes")
+@click.option("--recipe-dir", type=click.Path(), default=None,
+              help="extra recipe dir layered over builtins")
+def recipes_cmd(recipe_dir):
+    """List available recipes."""
+    from lambdipy_tpu.recipes import builtin_store
+
+    store = builtin_store(recipe_dir)
+    for name in store.names():
+        r = store.get(name)
+        kind = "model" if r.is_model else "package"
+        click.echo(f"{name:20s} {r.version:10s} {r.device:10s} {kind:8s} {r.description}")
+
+
+@main.command("show")
+@click.argument("recipe_name")
+@click.option("--recipe-dir", type=click.Path(), default=None)
+def show_cmd(recipe_name, recipe_dir):
+    """Show one recipe as JSON."""
+    import dataclasses
+
+    from lambdipy_tpu.recipes import builtin_store
+
+    recipe = builtin_store(recipe_dir).get(recipe_name)
+    click.echo(json.dumps(dataclasses.asdict(recipe), indent=1, default=str))
+
+
+@main.command("artifacts")
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+def artifacts_cmd(registry_dir):
+    """List artifacts in the local registry."""
+    from lambdipy_tpu.resolve.registry import ArtifactRegistry
+
+    for info in ArtifactRegistry(registry_dir).list():
+        click.echo(f"{info.artifact_id:45s} {info.size_bytes / 1e6:9.1f}MB  {info.device}")
+
+
+# -- build / package --------------------------------------------------------
+
+
+@main.command("build")
+@click.argument("recipe_name")
+@click.option("--out", type=click.Path(), default=None,
+              help="bundle output dir (default: temp + registry publish)")
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+@click.option("--recipe-dir", type=click.Path(), default=None)
+@click.option("--no-smoke", is_flag=True, help="skip the hermetic import smoke")
+@click.option("--no-payload", is_flag=True, help="skip params/handler materialization")
+@click.option("--force", is_flag=True, help="rebuild even if the artifact is cached")
+def build_cmd(recipe_name, out, registry_dir, recipe_dir, no_smoke, no_payload, force):
+    """Build a recipe into a bundle and publish it to the local registry
+    (cache-hit short-circuits like the reference's prebuilt fetch)."""
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.bundle import assemble_bundle
+    from lambdipy_tpu.recipes import builtin_store
+    from lambdipy_tpu.resolve.registry import ArtifactRegistry
+
+    store = builtin_store(recipe_dir)
+    recipe = store.get(recipe_name)
+    registry = ArtifactRegistry(registry_dir)
+    pyver = f"{sys.version_info.major}.{sys.version_info.minor}"
+    artifact_id = recipe.artifact_id(pyver)
+
+    if not force and out is None and registry.has(artifact_id):
+        click.echo(f"cache hit: {artifact_id} (use --force to rebuild)")
+        return
+
+    workdir = Path(tempfile.mkdtemp(prefix=f"lambdipy-build-{recipe.name}-"))
+    result = build_recipe(recipe, workdir, run_smoke=not no_smoke)
+    bundle_dir = Path(out) if out else workdir / "bundle"
+    manifest = assemble_bundle(result, bundle_dir,
+                               with_payload=not no_payload and recipe.is_model)
+    if out is None:
+        registry.publish(artifact_id, bundle_dir, recipe=recipe.name,
+                         version=recipe.version, device=recipe.device,
+                         manifest=manifest)
+        click.echo(f"built + published {artifact_id}")
+    else:
+        click.echo(f"built {artifact_id} -> {bundle_dir}")
+    p = result.prune
+    click.echo(f"size {p.bytes_after / 1e6:.1f}MB (saved {p.bytes_saved / 1e6:.1f}MB); "
+               f"skipped optional: {result.skipped_optional or 'none'}")
+
+
+@main.command("package")
+@click.argument("requirements", type=click.Path(exists=True))
+@click.option("--out", type=click.Path(), required=True, help="output build/ tree")
+@click.option("--recipe-dir", type=click.Path(), default=None)
+def package_cmd(requirements, out, recipe_dir):
+    """Assemble a deployable tree from a project requirements file: recipe-
+    covered deps built via their recipes, plain deps vendored directly
+    (SURVEY.md §4 B)."""
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.buildengine.vendor import dependency_closure, vendor_distribution
+    from lambdipy_tpu.recipes import builtin_store
+    from lambdipy_tpu.resolve import resolve_project
+
+    store = builtin_store(recipe_dir)
+    res = resolve_project(Path(requirements), store)
+    out_dir = Path(out)
+    site = out_dir / "site"
+    site.mkdir(parents=True, exist_ok=True)
+    for req, recipe_name in res.recipe_covered:
+        recipe = store.get(recipe_name)
+        workdir = Path(tempfile.mkdtemp(prefix=f"lambdipy-pkg-{recipe.name}-"))
+        result = build_recipe(recipe, workdir)
+        from lambdipy_tpu.utils.fsutil import copy_tree
+
+        copy_tree(result.site_dir, site)
+        click.echo(f"recipe {recipe_name}: {req.pin}")
+    vendored = set()
+    for req in res.plain:
+        for dep in dependency_closure([req.raw]):
+            if dep not in vendored and not (site / dep.replace("-", "_")).exists():
+                vendor_distribution(dep, site)
+                vendored.add(dep)
+        click.echo(f"plain dep: {req.pin}")
+    click.echo(f"packaged -> {out_dir} (add your handler.py and deploy)")
+
+
+# -- deploy / serve / invoke ------------------------------------------------
+
+
+def _resolve_bundle(name_or_dir: str, registry_dir) -> Path:
+    from lambdipy_tpu.recipes import builtin_store
+    from lambdipy_tpu.resolve.registry import ArtifactRegistry
+
+    path = Path(name_or_dir)
+    if path.is_dir() and (path / "manifest.json").exists():
+        return path
+    registry = ArtifactRegistry(registry_dir)
+    store = builtin_store()
+    if name_or_dir in store:
+        pyver = f"{sys.version_info.major}.{sys.version_info.minor}"
+        artifact_id = store.get(name_or_dir).artifact_id(pyver)
+        if registry.has(artifact_id):
+            return registry.fetch(artifact_id)
+        raise click.ClickException(
+            f"recipe {name_or_dir!r} has no built artifact; run: lambdipy build {name_or_dir}")
+    if registry.has(name_or_dir):
+        return registry.fetch(name_or_dir)
+    raise click.ClickException(f"{name_or_dir!r} is neither a bundle dir, recipe, nor artifact id")
+
+
+@main.command("deploy")
+@click.argument("bundle")
+@click.option("--name", default=None, help="deployment name (default: recipe/artifact)")
+@click.option("--port", type=int, default=0)
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+@click.option("--timeout", type=float, default=300.0)
+def deploy_cmd(bundle, name, port, registry_dir, timeout):
+    """Deploy a built bundle to the local TPU runtime."""
+    from lambdipy_tpu.runtime.deploy import LocalRuntime
+
+    bundle_dir = _resolve_bundle(bundle, registry_dir)
+    dep_name = name or bundle.split("/")[-1]
+    dep = LocalRuntime().deploy(dep_name, bundle_dir, port=port,
+                                ready_timeout=timeout)
+    click.echo(json.dumps({"name": dep.name, "url": dep.url,
+                           "cold_start": dep.cold_start}))
+
+
+@main.command("serve")
+@click.argument("bundle")
+@click.option("--port", type=int, default=8080)
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+def serve_cmd(bundle, port, registry_dir):
+    """Serve a bundle in the foreground."""
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    server = BundleServer(_resolve_bundle(bundle, registry_dir), port=port)
+    click.echo(json.dumps({"ready": True, "port": server.port,
+                           "cold_start": server.boot.stages}))
+    server.serve_forever()
+
+
+@main.command("invoke")
+@click.argument("name")
+@click.option("--data", default="{}", help="JSON request body")
+def invoke_cmd(name, data):
+    """Invoke a deployed function."""
+    from lambdipy_tpu.runtime.deploy import LocalRuntime
+
+    click.echo(json.dumps(LocalRuntime().invoke(name, json.loads(data))))
+
+
+@main.command("deployments")
+def deployments_cmd():
+    """List deployments."""
+    from lambdipy_tpu.runtime.deploy import LocalRuntime
+
+    for dep in LocalRuntime().list():
+        click.echo(f"{dep.name:25s} pid={dep.pid:<8d} {dep.url}")
+
+
+@main.command("stop")
+@click.argument("name")
+def stop_cmd(name):
+    """Stop a deployment."""
+    from lambdipy_tpu.runtime.deploy import LocalRuntime
+
+    LocalRuntime().stop(name)
+    click.echo(f"stopped {name}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Model payloads: flax implementations built MXU-first.
+
+The reference ships no models — its payloads are whatever heavy packages
+users depend on (SURVEY.md §1). Here the model families demanded by
+BASELINE.json configs 3-5 are first-class framework components: bf16
+compute, static shapes, ``lax.scan`` decode loops (no Python control flow
+under jit), and sharding-agnostic module code with TP/SP rules supplied by
+:mod:`lambdipy_tpu.parallel.sharding`.
+"""
+
+from lambdipy_tpu.models import registry
+
+__all__ = ["registry"]

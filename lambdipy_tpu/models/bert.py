@@ -1,0 +1,109 @@
+"""BERT-base encoder + classification head in flax.linen.
+
+BASELINE.json config 4's model family, implemented TPU-native (the
+torch-xla variant is the compatibility path; this is the serving path).
+bf16 matmuls, fp32 layernorm/softmax accumulations, static max_len so XLA
+compiles one shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    num_classes: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+BERT_BASE = BertConfig()
+BERT_TINY = BertConfig(vocab_size=1024, hidden=32, layers=2, heads=2,
+                       mlp=64, max_len=64, num_classes=2)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        head_dim = cfg.hidden // cfg.heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.heads, head_dim), axis=-1, dtype=cfg.dtype, name=name)
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        # fp32 softmax accumulation; bf16 matmuls feed the MXU
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        logits = logits / jnp.sqrt(head_dim).astype(jnp.float32)
+        logits = jnp.where(mask[:, None, None, :], logits, jnp.float32(-1e9))
+        probs = nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(cfg.hidden, axis=(-2, -1), dtype=cfg.dtype,
+                               name="out")(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, name=name)  # noqa: E731
+        y = SelfAttention(cfg, name="attn")(x, mask)
+        x = ln("ln_attn")(x + y).astype(cfg.dtype)
+        y = nn.Dense(cfg.mlp, dtype=cfg.dtype, name="mlp_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="mlp_out")(y)
+        return ln("ln_mlp")(x + y).astype(cfg.dtype)
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), dtype=jnp.bool_)
+        else:
+            attention_mask = attention_mask.astype(jnp.bool_)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), dtype=jnp.int32)
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                       name="tok_emb")(input_ids)
+        emb += nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype,
+                        name="pos_emb")(jnp.arange(s)[None, :])
+        emb += nn.Embed(cfg.type_vocab, cfg.hidden, dtype=cfg.dtype,
+                        name="type_emb")(token_type_ids)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32, name="emb_ln")(emb)
+        x = x.astype(cfg.dtype)
+        for i in range(cfg.layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+        return x
+
+
+class BertClassifier(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        x = BertEncoder(self.cfg, name="encoder")(
+            input_ids, attention_mask, token_type_ids)
+        cls = x[:, 0]  # [CLS] pooling
+        pooled = jnp.tanh(nn.Dense(self.cfg.hidden, dtype=self.cfg.dtype,
+                                   name="pooler")(cls))
+        return nn.Dense(self.cfg.num_classes, dtype=jnp.float32,
+                        name="classifier")(pooled)

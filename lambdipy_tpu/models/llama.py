@@ -1,0 +1,280 @@
+"""Llama-3-style decoder-only LM: GQA + RoPE + RMSNorm + SwiGLU, with int8
+weight-only quantization and a functional KV cache for ``lax.scan`` decode.
+
+BASELINE.json config 5: Llama-3-8B int8 generate on v5e-4, weights tensor-
+parallel over the ``tp`` mesh axis (sharding rules in
+:func:`llama_tp_rules`; the module itself is sharding-agnostic).
+
+TPU-first choices:
+- decode loop is ``lax.scan`` over a static-shape KV cache
+  (``dynamic_update_slice`` at the position index) — no Python control flow
+  under jit, one compiled step reused for every token;
+- int8 weight-only quant: weights stored int8 + per-output-channel fp32
+  scale, dequantized into bf16 at the matmul (HBM-bandwidth win: 8B params
+  fit v5e-4's 64 GB HBM with room for cache);
+- fp32 RMSNorm/softmax accumulation, bf16 MXU matmuls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 8
+    mlp: int = 14336
+    max_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    quant: str | None = None  # None | "int8"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+LLAMA3_8B = LlamaConfig()
+LLAMA_TINY = LlamaConfig(vocab_size=512, hidden=64, layers=2, heads=4,
+                         kv_heads=2, mlp=128, max_len=128, dtype=jnp.float32)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(dtype)
+
+
+class QDense(nn.Module):
+    """Linear layer with optional int8 weight-only quantization.
+
+    quant=None: a plain bf16 kernel. quant="int8": kernel stored as int8
+    with per-output-channel fp32 scales; dequantized at the matmul so HBM
+    traffic (the serving bottleneck) is 1 byte/param while the MXU still
+    sees bf16.
+    """
+
+    features: int
+    quant: str | None = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        if self.quant == "int8":
+            def init_int8(key, shape, _dtype):
+                w = nn.initializers.lecun_normal()(key, shape, jnp.float32)
+                scale = jnp.max(jnp.abs(w), axis=0, keepdims=True) / 127.0
+                return jnp.round(w / jnp.maximum(scale, 1e-8)).astype(jnp.int8)
+
+            w_i8 = self.param("kernel_int8", init_int8,
+                              (in_features, self.features), jnp.int8)
+            # random-init scale approximates lecun magnitude; real weights
+            # come through quantize_params() which computes true scales
+            scale = self.param(
+                "scale", nn.initializers.constant(1.0 / (127.0 * in_features ** 0.5)),
+                (1, self.features), jnp.float32)
+            w = w_i8.astype(self.dtype) * scale.astype(self.dtype)
+        else:
+            w = self.param("kernel", nn.initializers.lecun_normal(),
+                           (in_features, self.features), self.dtype)
+        return x.astype(self.dtype) @ w
+
+
+def rope(q, k, positions, theta: float):
+    """Rotary position embeddings, fp32 trig, applied per head-dim pair."""
+    head_dim = q.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                               axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def _attend(q, k, v, mask):
+    """Grouped-query attention core. q: [b,s,h,d]; k/v: [b,t,kvh,d]."""
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    group = h // kvh
+    q = q.reshape(b, s, kvh, group, d)
+    logits = jnp.einsum("bskgd,btkd->bkgst", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.where(mask[:, None, None, :, :], logits, jnp.float32(-1e9))
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mask, cache):
+        """cache: None (prefill over full x) or dict(k, v, index) for decode.
+        Returns (y, new_cache_entry)."""
+        cfg = self.cfg
+        d = cfg.head_dim
+        h = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
+        b, s, _ = h.shape
+        q = QDense(cfg.heads * d, cfg.quant, cfg.dtype, name="q_proj")(h)
+        k = QDense(cfg.kv_heads * d, cfg.quant, cfg.dtype, name="k_proj")(h)
+        v = QDense(cfg.kv_heads * d, cfg.quant, cfg.dtype, name="v_proj")(h)
+        q = q.reshape(b, s, cfg.heads, d)
+        k = k.reshape(b, s, cfg.kv_heads, d)
+        v = v.reshape(b, s, cfg.kv_heads, d)
+        q, k = rope(q, k, positions, cfg.rope_theta)
+
+        if cache is None:
+            # prefill: causal mask over the full sequence
+            causal = jnp.tril(jnp.ones((s, s), dtype=jnp.bool_))
+            attn_mask = mask[:, None, :] & causal[None, :, :]
+            out = _attend(q, k, v, attn_mask)
+            new_cache = {"k": k, "v": v}
+        else:
+            # decode: append this step's k/v at cache index, attend over prefix
+            idx = cache["index"]  # scalar int32
+            ck = jax.lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
+            t = ck.shape[1]
+            valid = jnp.arange(t)[None, :] <= idx  # [1, t]
+            attn_mask = jnp.broadcast_to(valid[:, None, :], (b, s, t))
+            out = _attend(q, ck, cv, attn_mask)
+            new_cache = {"k": ck, "v": cv}
+
+        out = out.reshape(b, s, cfg.heads * d)
+        x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, name="o_proj")(out)
+
+        h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
+        gate = QDense(cfg.mlp, cfg.quant, cfg.dtype, name="gate_proj")(h)
+        up = QDense(cfg.mlp, cfg.quant, cfg.dtype, name="up_proj")(h)
+        x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, name="down_proj")(
+            nn.silu(gate) * up)
+        return x, new_cache
+
+
+class LlamaModel(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, mask=None, cache=None):
+        """Returns (logits, new_cache).
+
+        prefill: cache=None, tokens [b, s] -> cache entries sized s.
+        decode:  cache=list of {k,v,index} (static max_len), tokens [b, 1].
+        """
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        if mask is None:
+            mask = jnp.ones((b, s), dtype=jnp.bool_)
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                       param_dtype=cfg.dtype, name="embed")
+        x = emb(tokens)
+        new_cache = []
+        for i in range(cfg.layers):
+            layer_cache = None if cache is None else cache[i]
+            x, c = LlamaBlock(cfg, name=f"layer_{i}")(x, positions, mask, layer_cache)
+            new_cache.append(c)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = QDense(cfg.vocab_size, cfg.quant, jnp.float32, name="lm_head")(x)
+        return logits, new_cache
+
+
+def init_decode_cache(cfg: LlamaConfig, batch: int, max_len: int):
+    """Static-shape KV cache for decode (one entry per layer)."""
+    return [
+        {
+            "k": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim), cfg.dtype),
+            "v": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim), cfg.dtype),
+            "index": jnp.int32(0),
+        }
+        for _ in range(cfg.layers)
+    ]
+
+
+def prefill_into_cache(cfg: LlamaConfig, prefill_cache, batch: int, max_len: int,
+                       prompt_len: int):
+    """Embed a prefill cache (entries sized prompt_len) into a static
+    max_len decode cache."""
+    out = []
+    for entry in prefill_cache:
+        k = jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+        v = jnp.zeros_like(k)
+        k = jax.lax.dynamic_update_slice(k, entry["k"].astype(cfg.dtype), (0, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(v, entry["v"].astype(cfg.dtype), (0, 0, 0, 0))
+        out.append({"k": k, "v": v, "index": jnp.int32(prompt_len)})
+    return out
+
+
+def quantize_params(float_params):
+    """Convert a float LlamaModel params pytree (quant=None) into the int8
+    layout (quant="int8"): each QDense ``kernel`` becomes ``kernel_int8`` +
+    per-output-channel ``scale``. Embeddings and norms stay float."""
+
+    def convert(tree):
+        if isinstance(tree, dict):
+            if "kernel" in tree and getattr(tree["kernel"], "ndim", 0) == 2:
+                w = jnp.asarray(tree["kernel"], jnp.float32)
+                scale = jnp.max(jnp.abs(w), axis=0, keepdims=True) / 127.0
+                scale = jnp.maximum(scale, 1e-8)
+                out = dict(tree)
+                del out["kernel"]
+                out["kernel_int8"] = jnp.round(w / scale).astype(jnp.int8)
+                out["scale"] = scale
+                return out
+            return {k: convert(v) for k, v in tree.items()}
+        return tree
+
+    return convert(float_params)
+
+
+def greedy_generate(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
+                    max_len: int | None = None):
+    """Greedy decode: prefill once, then ``lax.scan`` one compiled step per
+    token. prompt_tokens: [b, s] int32. Returns [b, max_new_tokens]."""
+    cfg = model.cfg
+    b, s = prompt_tokens.shape
+    max_len = max_len or min(cfg.max_len, s + max_new_tokens)
+
+    logits, prefill_cache = model.apply(params, prompt_tokens)
+    cache = prefill_into_cache(cfg, prefill_cache, b, max_len, s)
+    first_token = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        tok, cache, pos = carry
+        positions = jnp.broadcast_to(pos[None, None], (b, 1))
+        logits, new_cache = model.apply(params, tok[:, None], positions=positions,
+                                        cache=cache)
+        for entry in new_cache:
+            entry["index"] = pos + 1
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return (nxt, new_cache, pos + 1), tok
+
+    for entry in cache:
+        entry["index"] = jnp.int32(s)
+    (_, _, _), toks = jax.lax.scan(
+        step, (first_token, cache, jnp.int32(s)), None, length=max_new_tokens)
+    return jnp.transpose(toks)  # [b, max_new_tokens]

@@ -1,0 +1,371 @@
+"""Model registry: payload model names -> builders, params IO, TP rules.
+
+Recipes name their payload model (``[payload] model = "resnet50"``); the
+registry maps that name to a family adapter: how to construct the module,
+make an example batch (for warmup/AOT), initialize + save params into the
+bundle (orbax for JAX families — SURVEY.md §6 checkpoint row), and which
+tensor-parallel sharding rules apply on a multi-chip mesh.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+from lambdipy_tpu.utils.logs import get_logger
+
+log = get_logger("lambdipy.models")
+
+
+class ModelError(KeyError):
+    pass
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    kind: str  # "jax" | "sklearn" | "torch"
+    build: Callable[..., Any]  # kind-specific builder, see adapters below
+    description: str = ""
+    tags: tuple[str, ...] = ()
+
+
+_MODELS: dict[str, ModelSpec] = {}
+
+
+def register(name: str, kind: str, description: str = "", tags: tuple[str, ...] = ()):
+    def deco(fn):
+        _MODELS[name] = ModelSpec(name=name, kind=kind, build=fn,
+                                  description=description, tags=tags)
+        return fn
+    return deco
+
+
+def get(name: str) -> ModelSpec:
+    try:
+        return _MODELS[name]
+    except KeyError:
+        raise ModelError(
+            f"unknown model {name!r}; registered: {sorted(_MODELS)}") from None
+
+
+def names() -> list[str]:
+    return sorted(_MODELS)
+
+
+# --------------------------------------------------------------------------
+# JAX family adapter
+
+
+@dataclass
+class JaxModel:
+    """Uniform wrapper over the flax model families."""
+
+    module: Any
+    example_batch: Callable[[int], Any]  # batch_size -> input pytree (tuple of args)
+    tp_rules: Any  # ShardingRules
+    forward: Callable[..., Any]  # (params, *batch) -> output
+    generate: Callable[..., Any] | None = None
+    config: Any = None
+
+    def init_params(self, seed: int = 0, batch_size: int = 1):
+        import jax
+
+        return self.module.init(jax.random.PRNGKey(seed), *self.example_batch(batch_size))
+
+
+def _dtype(name: str):
+    import jax.numpy as jnp
+
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+            "float16": jnp.float16}[name]
+
+
+@register("resnet50", "jax", "flax ResNet-50 image classifier (config 3)")
+def _build_resnet50(dtype: str = "bfloat16", quant: str | None = None,
+                    extra: dict | None = None) -> JaxModel:
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models.resnet import resnet50
+    from lambdipy_tpu.parallel.sharding import ShardingRules
+    from jax.sharding import PartitionSpec as P
+
+    extra = extra or {}
+    module = resnet50(num_classes=int(extra.get("num_classes", 1000)),
+                      dtype=_dtype(dtype))
+    size = int(extra.get("image_size", 224))
+
+    def example_batch(batch_size: int):
+        return (jnp.zeros((batch_size, size, size, 3), _dtype(dtype)),)
+
+    return JaxModel(
+        module=module,
+        example_batch=example_batch,
+        tp_rules=ShardingRules(rules=()),  # convnet serving: replicate, dp batch
+        forward=lambda params, x: module.apply(params, x, train=False),
+    )
+
+
+@register("resnet50-tiny", "jax", "tiny ResNet for tests/dry-runs")
+def _build_resnet_tiny(dtype: str = "float32", quant: str | None = None,
+                       extra: dict | None = None) -> JaxModel:
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models.resnet import resnet_tiny
+    from lambdipy_tpu.parallel.sharding import ShardingRules
+
+    module = resnet_tiny(dtype=_dtype(dtype))
+
+    def example_batch(batch_size: int):
+        return (jnp.zeros((batch_size, 32, 32, 3), _dtype(dtype)),)
+
+    return JaxModel(
+        module=module,
+        example_batch=example_batch,
+        tp_rules=ShardingRules(rules=()),
+        forward=lambda params, x: module.apply(params, x, train=False),
+    )
+
+
+def _bert_tp_rules():
+    from jax.sharding import PartitionSpec as P
+
+    from lambdipy_tpu.parallel.sharding import ShardingRules
+
+    return ShardingRules(rules=(
+        ("*attn/query/kernel", P(None, "tp", None)),
+        ("*attn/key/kernel", P(None, "tp", None)),
+        ("*attn/value/kernel", P(None, "tp", None)),
+        ("*attn/out/kernel", P("tp", None, None)),
+        ("*mlp_in/kernel", P(None, "tp")),
+        ("*mlp_out/kernel", P("tp", None)),
+    ))
+
+
+def _build_bert(cfg, dtype: str) -> JaxModel:
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models.bert import BertClassifier
+
+    module = BertClassifier(cfg)
+
+    def example_batch(batch_size: int):
+        ids = jnp.zeros((batch_size, cfg.max_len), jnp.int32)
+        mask = jnp.ones((batch_size, cfg.max_len), jnp.int32)
+        return (ids, mask)
+
+    return JaxModel(
+        module=module,
+        example_batch=example_batch,
+        tp_rules=_bert_tp_rules(),
+        forward=lambda params, ids, mask: module.apply(params, ids, mask),
+        config=cfg,
+    )
+
+
+@register("bert-base", "jax", "flax BERT-base text classifier (config 4 jax path)")
+def _build_bert_base(dtype: str = "bfloat16", quant: str | None = None,
+                     extra: dict | None = None) -> JaxModel:
+    import dataclasses
+
+    from lambdipy_tpu.models.bert import BERT_BASE
+
+    extra = extra or {}
+    cfg = dataclasses.replace(
+        BERT_BASE, dtype=_dtype(dtype),
+        max_len=int(extra.get("max_len", 128)),
+        num_classes=int(extra.get("num_classes", 2)))
+    return _build_bert(cfg, dtype)
+
+
+@register("bert-tiny", "jax", "tiny BERT for tests/dry-runs")
+def _build_bert_tiny(dtype: str = "float32", quant: str | None = None,
+                     extra: dict | None = None) -> JaxModel:
+    import dataclasses
+
+    from lambdipy_tpu.models.bert import BERT_TINY
+
+    cfg = dataclasses.replace(BERT_TINY, dtype=_dtype(dtype))
+    return _build_bert(cfg, dtype)
+
+
+def _llama_tp_rules():
+    from jax.sharding import PartitionSpec as P
+
+    from lambdipy_tpu.parallel.sharding import ShardingRules
+
+    return ShardingRules(rules=(
+        ("*embed/embedding", P("tp", None)),
+        ("*o_proj/kernel*", P("tp", None)),
+        ("*down_proj/kernel*", P("tp", None)),
+        ("*o_proj/scale", P()),
+        ("*down_proj/scale", P()),
+        ("*_proj/kernel*", P(None, "tp")),  # q/k/v/gate/up
+        ("*_proj/scale", P(None, "tp")),
+        ("*lm_head/kernel*", P(None, "tp")),
+        ("*lm_head/scale", P(None, "tp")),
+    ))
+
+
+def _build_llama(cfg) -> JaxModel:
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models.llama import LlamaModel, greedy_generate
+
+    module = LlamaModel(cfg)
+
+    def example_batch(batch_size: int):
+        return (jnp.zeros((batch_size, 16), jnp.int32),)
+
+    def generate(params, prompt, max_new_tokens=16, max_len=None):
+        return greedy_generate(module, params, prompt,
+                               max_new_tokens=max_new_tokens, max_len=max_len)
+
+    return JaxModel(
+        module=module,
+        example_batch=example_batch,
+        tp_rules=_llama_tp_rules(),
+        forward=lambda params, tokens: module.apply(params, tokens)[0],
+        generate=generate,
+        config=cfg,
+    )
+
+
+@register("llama3-8b", "jax", "Llama-3-8B int8 TP generate (config 5)")
+def _build_llama3_8b(dtype: str = "bfloat16", quant: str | None = "int8",
+                     extra: dict | None = None) -> JaxModel:
+    import dataclasses
+
+    from lambdipy_tpu.models.llama import LLAMA3_8B
+
+    extra = extra or {}
+    cfg = dataclasses.replace(
+        LLAMA3_8B, dtype=_dtype(dtype), quant=quant,
+        max_len=int(extra.get("max_len", 8192)))
+    return _build_llama(cfg)
+
+
+@register("llama-tiny", "jax", "tiny Llama for tests/dry-runs")
+def _build_llama_tiny(dtype: str = "float32", quant: str | None = None,
+                      extra: dict | None = None) -> JaxModel:
+    import dataclasses
+
+    from lambdipy_tpu.models.llama import LLAMA_TINY
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype=_dtype(dtype), quant=quant)
+    return _build_llama(cfg)
+
+
+# --------------------------------------------------------------------------
+# non-JAX families (configs 2 and 4 compatibility paths)
+
+
+@register("tabular", "sklearn", "sklearn tabular classifier (config 2)")
+def _build_tabular(dtype: str = "float32", quant: str | None = None,
+                   extra: dict | None = None):
+    extra = extra or {}
+    n_features = int(extra.get("n_features", 16))
+
+    def make_fitted(seed: int = 0):
+        import numpy as np
+        from sklearn.ensemble import GradientBoostingClassifier
+
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(256, n_features))
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+        clf = GradientBoostingClassifier(n_estimators=20, max_depth=3,
+                                         random_state=seed)
+        clf.fit(X, y)
+        return clf
+
+    return {"make_fitted": make_fitted, "n_features": n_features}
+
+
+@register("bert-base-torch", "torch", "torch BERT-base (config 4, torch-xla or CPU smoke)")
+def _build_bert_torch(dtype: str = "float32", quant: str | None = None,
+                      extra: dict | None = None):
+    extra = extra or {}
+
+    def make_model():
+        import torch
+
+        from lambdipy_tpu.models.torch_bert import TorchBertClassifier
+
+        model = TorchBertClassifier(
+            vocab_size=int(extra.get("vocab_size", 30522)),
+            hidden=int(extra.get("hidden", 768)),
+            layers=int(extra.get("layers", 12)),
+            heads=int(extra.get("heads", 12)),
+            max_len=int(extra.get("max_len", 128)),
+            num_classes=int(extra.get("num_classes", 2)),
+        )
+        model.eval()
+        return model
+
+    return {"make_model": make_model, "max_len": int(extra.get("max_len", 128))}
+
+
+# --------------------------------------------------------------------------
+# params IO (bundle build + serve sides)
+
+
+def save_init_params(model: str, params_dir: Path, *, dtype: str = "bfloat16",
+                     quant: str | None = None, extra: dict | None = None,
+                     seed: int = 0) -> dict:
+    """Initialize a model's params and persist them into a bundle params dir.
+    Returns an info dict recorded in the bundle manifest."""
+    spec = get(model)
+    params_dir = Path(params_dir)
+    params_dir.mkdir(parents=True, exist_ok=True)
+    if spec.kind == "jax":
+        import jax
+        import orbax.checkpoint as ocp
+
+        adapter = spec.build(dtype=dtype, quant=quant, extra=extra)
+        params = adapter.init_params(seed=seed)
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save((params_dir / "orbax").resolve(), params)
+        ckptr.wait_until_finished()
+        info = {"format": "orbax", "n_params": int(n_params), "seed": seed}
+    elif spec.kind == "sklearn":
+        import joblib
+
+        built = spec.build(dtype=dtype, quant=quant, extra=extra)
+        clf = built["make_fitted"](seed)
+        joblib.dump(clf, params_dir / "model.joblib")
+        info = {"format": "joblib", "n_features": built["n_features"]}
+    elif spec.kind == "torch":
+        import torch
+
+        built = spec.build(dtype=dtype, quant=quant, extra=extra)
+        model_obj = built["make_model"]()
+        torch.save(model_obj.state_dict(), params_dir / "model.pt")
+        info = {"format": "torch",
+                "n_params": sum(p.numel() for p in model_obj.parameters())}
+    else:
+        raise ModelError(f"unknown model kind {spec.kind!r}")
+    (params_dir / "info.json").write_text(json.dumps({"model": model, **info}))
+    return info
+
+
+def load_params(model: str, params_dir: Path):
+    """Load params previously saved by save_init_params."""
+    spec = get(model)
+    params_dir = Path(params_dir)
+    if spec.kind == "jax":
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore((params_dir / "orbax").resolve())
+    if spec.kind == "sklearn":
+        import joblib
+
+        return joblib.load(params_dir / "model.joblib")
+    if spec.kind == "torch":
+        import torch
+
+        return torch.load(params_dir / "model.pt", weights_only=True)
+    raise ModelError(f"unknown model kind {spec.kind!r}")

@@ -1,0 +1,85 @@
+"""ResNet-50 in flax.linen, bf16-first for the v5e MXU.
+
+BASELINE.json config 3 / north star: image-classify at <15 ms p50 on
+v5e-1. Design notes for the MXU: NHWC layout (XLA's native TPU conv
+layout), bf16 activations and conv kernels, fp32 batch-norm statistics
+(numerics), no dynamic shapes anywhere.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+from functools import partial
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int = 1
+    projection: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)], name="conv2")(y)
+        y = norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if self.projection:
+            residual = conv(self.features * 4, (1, 1),
+                            strides=(self.strides, self.strides), name="proj_conv")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=self.dtype, param_dtype=jnp.float32, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_count in enumerate(self.stage_sizes):
+            features = self.width * (2 ** i)
+            for j in range(block_count):
+                x = BottleneckBlock(
+                    features=features,
+                    strides=2 if (i > 0 and j == 0) else 1,
+                    projection=(j == 0),
+                    dtype=self.dtype,
+                    name=f"stage{i + 1}_block{j + 1}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        # classifier head in fp32 for logit numerics
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16, width: int = 64) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                  width=width, dtype=dtype)
+
+
+def resnet_tiny(num_classes: int = 10, dtype=jnp.bfloat16) -> ResNet:
+    """Small variant for tests and CPU-mesh dry runs."""
+    return ResNet(stage_sizes=(1, 1), num_classes=num_classes, width=8, dtype=dtype)

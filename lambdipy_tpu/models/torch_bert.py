@@ -1,0 +1,54 @@
+"""Torch BERT-base classifier for the torch-xla compatibility path.
+
+BASELINE.json config 4: "pytorch recipe -> torch-xla BERT-base". In this
+environment torch is CPU-only (no torch-xla wheel — SURVEY.md §3.3), so the
+handler moves the model to the XLA device when available and otherwise runs
+the documented CPU-torch smoke path (SURVEY.md §9.7). Built on stock
+``torch.nn`` blocks — the torch-idiomatic shape, not a port of the flax
+implementation.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch import nn
+
+
+class TorchBertClassifier(nn.Module):
+    def __init__(self, vocab_size: int = 30522, hidden: int = 768,
+                 layers: int = 12, heads: int = 12, max_len: int = 128,
+                 num_classes: int = 2, mlp_ratio: int = 4):
+        super().__init__()
+        self.max_len = max_len
+        self.tok_emb = nn.Embedding(vocab_size, hidden)
+        self.pos_emb = nn.Embedding(max_len, hidden)
+        self.emb_ln = nn.LayerNorm(hidden, eps=1e-12)
+        layer = nn.TransformerEncoderLayer(
+            d_model=hidden, nhead=heads, dim_feedforward=hidden * mlp_ratio,
+            activation="gelu", batch_first=True, norm_first=False)
+        self.encoder = nn.TransformerEncoder(layer, num_layers=layers)
+        self.pooler = nn.Linear(hidden, hidden)
+        self.classifier = nn.Linear(hidden, num_classes)
+
+    def forward(self, input_ids: torch.Tensor,
+                attention_mask: torch.Tensor | None = None) -> torch.Tensor:
+        b, s = input_ids.shape
+        pos = torch.arange(s, device=input_ids.device).unsqueeze(0)
+        x = self.emb_ln(self.tok_emb(input_ids) + self.pos_emb(pos))
+        pad_mask = None
+        if attention_mask is not None:
+            pad_mask = attention_mask == 0  # True = ignore
+        x = self.encoder(x, src_key_padding_mask=pad_mask)
+        pooled = torch.tanh(self.pooler(x[:, 0]))
+        return self.classifier(pooled)
+
+
+def xla_device_or_cpu():
+    """The torch-xla device when the wheel is present, else CPU (the
+    degraded smoke path the recipe documents)."""
+    try:
+        import torch_xla.core.xla_model as xm  # type: ignore
+
+        return xm.xla_device(), "xla"
+    except Exception:
+        return torch.device("cpu"), "cpu"

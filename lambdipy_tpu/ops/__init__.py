@@ -1,0 +1,10 @@
+"""Hot ops: Pallas TPU kernels with reference (pure-jax) fallbacks.
+
+Every op ships two implementations: a Pallas/Mosaic kernel for the TPU hot
+path and a pure-jax reference used on CPU, under interpret mode in tests,
+and as the numerics oracle.
+"""
+
+from lambdipy_tpu.ops.attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

@@ -1,0 +1,33 @@
+"""SPMD parallelism: device meshes, sharding rules, collectives.
+
+The reference has no distributed components at all (SURVEY.md §3.2 — it is
+a single-process CLI tool); this package is new TPU-first surface required
+by BASELINE.json config 5 (Llama-3-8B tensor-parallel on v5e-4) and the
+framework's long-context goals. All communication is XLA collectives over
+ICI emitted by jit/shard_map from sharding annotations — never hand-rolled
+transports (there is no NCCL on TPU).
+"""
+
+from lambdipy_tpu.parallel.mesh import (
+    MESH_AXES,
+    flat_mesh,
+    make_mesh,
+    mesh_shape_for,
+)
+from lambdipy_tpu.parallel.sharding import (
+    ShardingRules,
+    named_sharding,
+    shard_batch,
+    shard_params,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "ShardingRules",
+    "flat_mesh",
+    "make_mesh",
+    "mesh_shape_for",
+    "named_sharding",
+    "shard_batch",
+    "shard_params",
+]

@@ -1,0 +1,71 @@
+"""Device-mesh construction.
+
+Canonical axis names, in nesting order (outermost first — DCN-adjacent axes
+outermost, ICI-heavy axes innermost so bandwidth-hungry collectives ride
+ICI, per the scaling-book recipe):
+
+- ``dp``   data parallel (pure replication of params, sharded batch)
+- ``fsdp`` fully-sharded data parallel (params sharded over batch axis)
+- ``pp``   pipeline parallel (stage dimension; lax.ppermute microbatching)
+- ``tp``   tensor parallel (heads/mlp/vocab sharded; all-reduce per block)
+- ``sp``   sequence/context parallel (ring attention over seq axis)
+- ``ep``   expert parallel (MoE expert dimension)
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES: tuple[str, ...] = ("dp", "fsdp", "pp", "tp", "sp", "ep")
+
+
+def make_mesh(shape: dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh from {axis: size}; axes absent from ``shape`` get size 1.
+
+    Sizes must multiply to the device count used. ``shape`` values of -1 are
+    filled with the remaining device factor (at most one -1).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = dict(shape)
+    unknown = set(sizes) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; known: {MESH_AXES}")
+    n = len(devices)
+    fills = [a for a, s in sizes.items() if s == -1]
+    if len(fills) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    if fills:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+        sizes[fills[0]] = n // fixed
+    total = math.prod(sizes.values()) if sizes else 1
+    if total != n:
+        raise ValueError(
+            f"mesh shape {sizes} needs {total} devices, have {n}")
+    axis_names = [a for a in MESH_AXES if sizes.get(a, 1) > 1] or ["dp"]
+    dims = [sizes.get(a, 1) for a in axis_names]
+    arr = np.asarray(devices).reshape(dims)
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def flat_mesh(axis: str = "dp", devices=None) -> Mesh:
+    """All devices on a single named axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), axis_names=(axis,))
+
+
+def mesh_shape_for(n_devices: int, *, tp: int | None = None,
+                   sp: int = 1, pp: int = 1) -> dict[str, int]:
+    """Default mesh shape for n devices: fill tp up to 4 (one v5e host's
+    worth of ICI-adjacent chips), rest dp. Serving configs override."""
+    if tp is None:
+        tp = math.gcd(n_devices, 4)
+    denom = tp * sp * pp
+    if n_devices % denom:
+        raise ValueError(f"{n_devices} devices not divisible by tp*sp*pp={denom}")
+    return {"dp": n_devices // denom, "pp": pp, "tp": tp, "sp": sp}

@@ -1,0 +1,14 @@
+"""Serve runtime: the rebuild's #1 new call stack (SURVEY.md §4 E).
+
+The reference stops at producing a zip for Lambda; the Lambda runtime that
+boots it defines the cold-start/latency metrics. Here that runtime is a
+framework component: bundle loader (sys.path layering over the base layer,
+compilation-cache attach), handler protocol, warmup, HTTP serve loop with
+structured metrics, and a local deploy target that stands in for the
+TPU-serverless control plane.
+"""
+
+from lambdipy_tpu.runtime.loader import BootReport, load_bundle
+from lambdipy_tpu.runtime.metrics import LatencyStats
+
+__all__ = ["BootReport", "LatencyStats", "load_bundle"]

@@ -1,0 +1,170 @@
+"""Deploy layer: local-process stand-in for the TPU serverless runtime.
+
+The reference's publish layer uploads artifacts to GitHub Releases and
+leaves deployment to the user (SURVEY.md §2 publish row); the rebuild gains
+a real deploy target (SURVEY.md §9.9). ``LocalRuntime`` spawns a bundle
+server subprocess, waits for the readiness line, health-checks it, and
+records the deployment — the same control-plane contract a Cloud-Run-on-TPU
+target would implement (deploy/list/invoke/stop against a URL).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass
+from pathlib import Path
+
+from lambdipy_tpu.utils.fsutil import atomic_write_text
+from lambdipy_tpu.utils.logs import get_logger, log_event
+
+log = get_logger("lambdipy.deploy")
+
+DEFAULT_STATE = Path.home() / ".lambdipy-tpu" / "deployments.json"
+
+
+class DeployError(RuntimeError):
+    pass
+
+
+@dataclass
+class Deployment:
+    name: str
+    bundle_dir: str
+    pid: int
+    port: int
+    cold_start: dict
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+def _http_json(url: str, payload: dict | None = None, timeout: float = 30.0) -> dict:
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class LocalRuntime:
+    """Process-per-function local runtime with a persisted deployment table."""
+
+    def __init__(self, state_path: Path | None = None):
+        self.state_path = Path(state_path) if state_path else DEFAULT_STATE
+        self.state_path.parent.mkdir(parents=True, exist_ok=True)
+
+    def _load(self) -> dict:
+        if self.state_path.exists():
+            return json.loads(self.state_path.read_text())
+        return {}
+
+    def _save(self, state: dict) -> None:
+        atomic_write_text(self.state_path, json.dumps(state, indent=1))
+
+    def deploy(self, name: str, bundle_dir: Path, *, port: int = 0,
+               ready_timeout: float = 300.0, env: dict | None = None) -> Deployment:
+        """Spawn a server for the bundle and wait until it reports ready.
+
+        ``ready_timeout`` is generous because cold start includes PJRT init
+        + first compile on a cold compile cache (BASELINE.md ~10 s floor).
+        """
+        bundle_dir = Path(bundle_dir).resolve()
+        state = self._load()
+        if name in state:
+            raise DeployError(f"deployment {name!r} already exists; stop it first")
+        cmd = [sys.executable, "-m", "lambdipy_tpu.runtime.server",
+               str(bundle_dir), str(port)]
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        # the framework itself must be importable in the server process
+        repo_root = str(Path(__file__).resolve().parents[2])
+        full_env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + [p for p in full_env.get("PYTHONPATH", "").split(os.pathsep) if p])
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                                text=True, env=full_env, start_new_session=True)
+        deadline = time.monotonic() + ready_timeout
+        ready_line = None
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                if proc.poll() is not None:
+                    raise DeployError(
+                        f"server for {name!r} exited rc={proc.returncode} before ready")
+                time.sleep(0.05)
+                continue
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if parsed.get("ready"):
+                ready_line = parsed
+                break
+        if ready_line is None:
+            proc.kill()
+            raise DeployError(f"deployment {name!r} not ready within {ready_timeout}s")
+        dep = Deployment(name=name, bundle_dir=str(bundle_dir), pid=proc.pid,
+                         port=ready_line["port"],
+                         cold_start=ready_line.get("cold_start", {}))
+        state[name] = dep.__dict__
+        self._save(state)
+        log_event(log, "deployed", name=name, port=dep.port,
+                  cold_start=dep.cold_start)
+        return dep
+
+    def list(self) -> list[Deployment]:
+        return [Deployment(**v) for v in self._load().values()]
+
+    def get(self, name: str) -> Deployment:
+        state = self._load()
+        if name not in state:
+            raise DeployError(f"no deployment named {name!r}")
+        return Deployment(**state[name])
+
+    def invoke(self, name: str, request: dict, timeout: float = 60.0) -> dict:
+        dep = self.get(name)
+        return _http_json(f"{dep.url}/invoke", request, timeout=timeout)
+
+    def health(self, name: str) -> dict:
+        return _http_json(f"{self.get(name).url}/healthz")
+
+    def metrics(self, name: str) -> dict:
+        return _http_json(f"{self.get(name).url}/metrics")
+
+    def stop(self, name: str, *, grace: float = 5.0) -> None:
+        dep = self.get(name)
+        try:
+            _http_json(f"{dep.url}/shutdown", {})
+        except Exception:
+            pass
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if not _pid_alive(dep.pid):
+                break
+            time.sleep(0.1)
+        if _pid_alive(dep.pid):
+            try:
+                os.kill(dep.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        state = self._load()
+        state.pop(name, None)
+        self._save(state)
+        log_event(log, "stopped", name=name)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False

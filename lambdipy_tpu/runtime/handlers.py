@@ -1,0 +1,289 @@
+"""Handler builders for the builtin model recipes.
+
+A handler is what a bundle's generated ``handler.py`` delegates to: a
+builder ``(spec, ctx) -> state`` where the returned state exposes
+``invoke(request: dict) -> dict``. Requests/responses are JSON dicts (the
+Lambda handler shape the reference's users write by hand — SURVEY.md §4 B
+"user zips build/ + handler.py"; here handlers are generated and TPU-aware).
+
+Every JAX handler jits once at init (cold start), accepts
+``{"warmup": true}``, and supports ``{"random": true}`` for benchmarking
+without a real payload.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class HandlerState:
+    invoke_fn: Callable[[dict], dict]
+    meta: dict
+
+    def invoke(self, request: dict) -> dict:
+        t0 = time.monotonic()
+        out = self.invoke_fn(dict(request or {}))
+        out.setdefault("latency_ms", round((time.monotonic() - t0) * 1e3, 3))
+        return out
+
+
+# --------------------------------------------------------------------------
+
+
+def hello_handler(spec: dict, ctx) -> HandlerState:
+    """Config 1: numpy+scipy hello world — a small deterministic linalg op
+    proving the vendored native stack works inside the bundle."""
+    import numpy as np
+    from scipy import linalg
+
+    def invoke(req: dict) -> dict:
+        n = int(req.get("n", 64))
+        rng = np.random.default_rng(int(req.get("seed", 0)))
+        a = rng.normal(size=(n, n))
+        sign, logdet = np.linalg.slogdet(a @ a.T + n * np.eye(n))
+        lu = linalg.lu_factor(a + n * np.eye(n))[0]
+        return {
+            "ok": True,
+            "n": n,
+            "logdet": float(logdet * sign),
+            "lu_trace": float(np.trace(lu)),
+            "numpy": np.__version__,
+        }
+
+    return HandlerState(invoke_fn=invoke, meta={"model": "hello"})
+
+
+def tabular_handler(spec: dict, ctx) -> HandlerState:
+    """Config 2: sklearn (+xgboost when vendored) tabular inference."""
+    import numpy as np
+
+    from lambdipy_tpu.models import registry
+
+    clf = registry.load_params("tabular", ctx.params_dir)
+    n_features = getattr(clf, "n_features_in_", 16)
+    degraded = ctx.degraded()
+
+    def invoke(req: dict) -> dict:
+        if req.get("warmup") or req.get("random"):
+            x = np.zeros((1, n_features))
+        else:
+            x = np.asarray(req["instances"], dtype=float)
+            if x.ndim == 1:
+                x = x[None, :]
+        proba = clf.predict_proba(x)
+        return {
+            "ok": True,
+            "predictions": proba.argmax(1).tolist(),
+            "probabilities": proba.tolist(),
+            "degraded": degraded,  # e.g. ["xgboost"] in this offline env
+        }
+
+    return HandlerState(invoke_fn=invoke,
+                        meta={"model": "tabular", "n_features": n_features})
+
+
+# --------------------------------------------------------------------------
+
+
+def _jax_adapter_and_params(spec: dict, ctx):
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get(spec["model"]).build(
+        dtype=spec.get("dtype", "bfloat16"), quant=spec.get("quant"),
+        extra=spec.get("extra") or {})
+    if ctx.params_dir is not None:
+        params = registry.load_params(spec["model"], ctx.params_dir)
+    else:
+        params = adapter.init_params(seed=0)
+    return adapter, params
+
+
+def _maybe_shard(adapter, params, spec: dict):
+    """Place params on the payload mesh when it needs more than one device;
+    single-chip serving skips mesh machinery entirely."""
+    import jax
+
+    mesh_shape = {k: v for k, v in (spec.get("mesh") or {}).items() if v > 1}
+    if not mesh_shape:
+        return params, None
+    from lambdipy_tpu.parallel.mesh import make_mesh
+    from lambdipy_tpu.parallel.sharding import shard_params
+
+    needed = 1
+    for v in mesh_shape.values():
+        needed *= v
+    if len(jax.devices()) < needed:
+        return params, None  # degrade to single-device (recorded by caller)
+    mesh = make_mesh(mesh_shape)
+    return shard_params(params, mesh, adapter.tp_rules), mesh
+
+
+def image_classify_handler(spec: dict, ctx) -> HandlerState:
+    """Config 3 / north star: ResNet-50 image classification on v5e."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    adapter, params = _jax_adapter_and_params(spec, ctx)
+    params, mesh = _maybe_shard(adapter, params, spec)
+    batch = int(spec.get("batch_size", 1))
+    example = adapter.example_batch(batch)[0]
+    fwd = jax.jit(adapter.forward)
+
+    def run(x):
+        if mesh is not None:
+            with mesh:
+                return fwd(params, x)
+        return fwd(params, x)
+
+    def invoke(req: dict) -> dict:
+        if req.get("warmup") or req.get("random"):
+            x = example
+        else:
+            x = jnp.asarray(np.asarray(req["image"], dtype=np.float32),
+                            example.dtype)
+            if x.ndim == 3:
+                x = x[None, ...]
+        logits = np.asarray(jax.device_get(run(x)), dtype=np.float32)
+        top = np.argsort(-logits, axis=-1)[:, :5]
+        return {
+            "ok": True,
+            "top5": top.tolist(),
+            "top1": top[:, 0].tolist(),
+            "logit_max": float(logits.max()),
+        }
+
+    return HandlerState(invoke_fn=invoke, meta={
+        "model": spec["model"], "batch": batch,
+        "sharded": mesh is not None,
+        "platform": jax.devices()[0].platform,
+    })
+
+
+def text_classify_handler(spec: dict, ctx) -> HandlerState:
+    """Config 4 (jax path): BERT text classification."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    adapter, params = _jax_adapter_and_params(spec, ctx)
+    params, mesh = _maybe_shard(adapter, params, spec)
+    cfg = adapter.config
+    fwd = jax.jit(adapter.forward)
+    example_ids, example_mask = adapter.example_batch(int(spec.get("batch_size", 1)))
+
+    def run(ids, mask):
+        if mesh is not None:
+            with mesh:
+                return fwd(params, ids, mask)
+        return fwd(params, ids, mask)
+
+    def invoke(req: dict) -> dict:
+        if req.get("warmup") or req.get("random"):
+            ids, mask = example_ids, example_mask
+        else:
+            raw = np.asarray(req["input_ids"], dtype=np.int32)
+            if raw.ndim == 1:
+                raw = raw[None, :]
+            ids = np.zeros((raw.shape[0], cfg.max_len), np.int32)
+            mask = np.zeros((raw.shape[0], cfg.max_len), np.int32)
+            n = min(cfg.max_len, raw.shape[1])
+            ids[:, :n] = raw[:, :n]
+            mask[:, :n] = 1
+            ids, mask = jnp.asarray(ids), jnp.asarray(mask)
+        logits = np.asarray(jax.device_get(run(ids, mask)), dtype=np.float32)
+        return {
+            "ok": True,
+            "labels": logits.argmax(-1).tolist(),
+            "logits": logits.tolist(),
+        }
+
+    return HandlerState(invoke_fn=invoke, meta={
+        "model": spec["model"], "max_len": cfg.max_len,
+        "sharded": mesh is not None,
+    })
+
+
+def generate_handler(spec: dict, ctx) -> HandlerState:
+    """Config 5: Llama TP int8 greedy generation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    adapter, params = _jax_adapter_and_params(spec, ctx)
+    params, mesh = _maybe_shard(adapter, params, spec)
+    default_new = int((spec.get("extra") or {}).get("max_new_tokens", 16))
+
+    def run(prompt, max_new):
+        if mesh is not None:
+            with mesh:
+                return adapter.generate(params, prompt, max_new_tokens=max_new)
+        return adapter.generate(params, prompt, max_new_tokens=max_new)
+
+    def invoke(req: dict) -> dict:
+        if req.get("warmup") or req.get("random"):
+            prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        else:
+            raw = np.asarray(req["tokens"], dtype=np.int32)
+            prompt = jnp.asarray(raw[None, :] if raw.ndim == 1 else raw)
+        max_new = int(req.get("max_new_tokens", default_new))
+        toks = np.asarray(jax.device_get(run(prompt, max_new)))
+        return {"ok": True, "tokens": toks.tolist(), "n_new": int(toks.shape[-1])}
+
+    return HandlerState(invoke_fn=invoke, meta={
+        "model": spec["model"], "quant": spec.get("quant"),
+        "sharded": mesh is not None,
+    })
+
+
+def torch_text_classify_handler(spec: dict, ctx) -> HandlerState:
+    """Config 4 (torch path): torch-xla when available, CPU-torch smoke
+    otherwise (SURVEY.md §9.7) — the degradation is reported per-invoke."""
+    import numpy as np
+    import torch
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.models.torch_bert import TorchBertClassifier, xla_device_or_cpu
+
+    extra = spec.get("extra") or {}
+    model = TorchBertClassifier(
+        vocab_size=int(extra.get("vocab_size", 30522)),
+        hidden=int(extra.get("hidden", 768)),
+        layers=int(extra.get("layers", 12)),
+        heads=int(extra.get("heads", 12)),
+        max_len=int(extra.get("max_len", 128)),
+        num_classes=int(extra.get("num_classes", 2)),
+    )
+    if ctx.params_dir is not None:
+        model.load_state_dict(registry.load_params("bert-base-torch", ctx.params_dir))
+    model.eval()
+    device, device_kind = xla_device_or_cpu()
+    model = model.to(device)
+    max_len = model.max_len
+
+    def invoke(req: dict) -> dict:
+        if req.get("warmup") or req.get("random"):
+            ids = torch.zeros(1, max_len, dtype=torch.long)
+            mask = torch.ones(1, max_len, dtype=torch.long)
+        else:
+            raw = np.asarray(req["input_ids"], dtype=np.int64)
+            if raw.ndim == 1:
+                raw = raw[None, :]
+            ids = torch.zeros(raw.shape[0], max_len, dtype=torch.long)
+            mask = torch.zeros(raw.shape[0], max_len, dtype=torch.long)
+            n = min(max_len, raw.shape[1])
+            ids[:, :n] = torch.from_numpy(raw[:, :n])
+            mask[:, :n] = 1
+        with torch.no_grad():
+            logits = model(ids.to(device), mask.to(device)).cpu().numpy()
+        return {
+            "ok": True,
+            "labels": logits.argmax(-1).tolist(),
+            "device": device_kind,  # "cpu" = the documented degraded path
+        }
+
+    return HandlerState(invoke_fn=invoke,
+                        meta={"model": spec["model"], "device": device_kind})

@@ -1,0 +1,136 @@
+"""Bundle boot: manifest -> importable, warmed handler.
+
+The cold-start path (SURVEY.md §4 D/E): every stage is timed because the
+<10 s budget is consumed by interpreter + PJRT init + first compile
+(BASELINE.md). The loader:
+
+1. reads + verifies the manifest, checks base-layer version skew,
+2. layers sys.path: bundle ``site/`` first, base layer (host site) after,
+3. points JAX's persistent compilation cache at the bundle's
+   ``compile_cache/`` (shipped warm by the builder -> first compile becomes
+   a cache hit, SURVEY.md §9.6),
+4. imports ``handler.py``, calls ``init(ctx)``, runs a warmup invoke.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+from lambdipy_tpu.bundle.baselayer import check_skew, runtime_sys_path
+from lambdipy_tpu.bundle.format import load_manifest
+from lambdipy_tpu.utils.logs import get_logger, log_event
+from lambdipy_tpu.utils.timing import StageTimer
+
+log = get_logger("lambdipy.runtime")
+
+
+@dataclass
+class HandlerContext:
+    """What a bundle handler gets at init time."""
+
+    bundle_dir: Path
+    manifest: dict
+    params_dir: Path | None
+    spec: dict  # payload spec from the manifest
+
+    def degraded(self) -> list[str]:
+        return list(self.manifest.get("provenance", {}).get("skipped_optional", []))
+
+
+@dataclass
+class BootReport:
+    bundle_dir: Path
+    handler: Any
+    state: Any
+    stages: dict[str, float] = field(default_factory=dict)
+    skew: dict = field(default_factory=dict)
+    warmup_result: Any = None
+
+    def cold_start_s(self) -> float:
+        return sum(self.stages.values())
+
+
+def attach_compile_cache(bundle_dir: Path) -> bool:
+    """Point JAX's persistent compilation cache at the bundle's cache dir
+    (created if absent, so the first boot warms it for the next)."""
+    cache_dir = Path(bundle_dir) / "compile_cache"
+    try:
+        import jax
+
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return True
+    except Exception as e:  # non-jax bundles don't care
+        log.warning("compile cache attach failed: %s", e)
+        return False
+
+
+def load_bundle(bundle_dir: Path, *, warmup: bool = True) -> BootReport:
+    bundle_dir = Path(bundle_dir)
+    timer = StageTimer()
+
+    with timer.stage("manifest"):
+        manifest = load_manifest(bundle_dir)
+        payload = manifest.get("payload")
+        if payload is None:
+            raise ValueError(f"bundle {bundle_dir} has no payload; nothing to serve")
+        base = manifest.get("base_layer", {"name": "none", "versions": {}})
+        skew = check_skew(base.get("versions", {}), base.get("name", "none"))
+        if skew:
+            log_event(log, "base layer skew detected", skew=skew)
+
+    with timer.stage("syspath"):
+        site_dir = bundle_dir / "site"
+        for p in reversed(runtime_sys_path(site_dir, base.get("name", "none"))):
+            if p not in sys.path:
+                sys.path.insert(0, p)
+
+    with timer.stage("compile_cache"):
+        from lambdipy_tpu.models import registry as model_registry
+
+        try:
+            uses_jax = model_registry.get(payload.get("model", "")).kind == "jax"
+        except Exception:
+            uses_jax = False
+        if uses_jax:
+            attach_compile_cache(bundle_dir)
+
+    with timer.stage("handler_import"):
+        spec = importlib.util.spec_from_file_location(
+            f"lambdipy_bundle_handler_{bundle_dir.name}", bundle_dir / "handler.py")
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+
+    with timer.stage("init"):
+        params_dir = bundle_dir / "params"
+        ctx = HandlerContext(
+            bundle_dir=bundle_dir,
+            manifest=manifest,
+            params_dir=params_dir if params_dir.is_dir() else None,
+            spec=dict(payload),
+        )
+        state = module.init(ctx)
+
+    warmup_result = None
+    if warmup:
+        with timer.stage("warmup"):
+            warmup_result = module.invoke(state, {"warmup": True})
+
+    report = BootReport(
+        bundle_dir=bundle_dir,
+        handler=module,
+        state=state,
+        stages=timer.report(),
+        skew=skew,
+        warmup_result=warmup_result,
+    )
+    log_event(log, "bundle booted", bundle=str(bundle_dir),
+              cold_start=report.stages, skew=bool(skew))
+    return report

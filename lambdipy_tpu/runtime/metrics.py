@@ -1,0 +1,51 @@
+"""Serve metrics: invoke latency percentiles + cold-start breakdown.
+
+SURVEY.md §6 metrics row: the reference has stdout echo only; the rebuild
+keeps p50/p99 and cold-start stage timings as first-class, exported on
+``/metrics`` as JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LatencyStats:
+    """Bounded reservoir of recent latencies (ms) with percentile report."""
+
+    capacity: int = 2048
+    samples: list[float] = field(default_factory=list)
+    count: int = 0
+    errors: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record(self, ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            if len(self.samples) >= self.capacity:
+                self.samples[self.count % self.capacity] = ms
+            else:
+                self.samples.append(ms)
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def percentile(self, q: float) -> float | None:
+        with self._lock:
+            if not self.samples:
+                return None
+            s = sorted(self.samples)
+            idx = min(len(s) - 1, max(0, round(q / 100.0 * (len(s) - 1))))
+            return s[idx]
+
+    def report(self) -> dict:
+        return {
+            "count": self.count,
+            "errors": self.errors,
+            "p50_ms": self.percentile(50),
+            "p90_ms": self.percentile(90),
+            "p99_ms": self.percentile(99),
+        }

@@ -1,0 +1,157 @@
+"""HTTP serve loop for a booted bundle.
+
+stdlib ThreadingHTTPServer (SURVEY.md §9.5: enough for v1; invokes are
+device-bound so Python threading overhead is noise next to device dispatch).
+Endpoints:
+
+- ``GET  /healthz``  liveness + boot/cold-start report (watchdog surface)
+- ``GET  /metrics``  latency percentiles + error counts (JSON)
+- ``POST /invoke``   JSON request -> handler -> JSON response
+
+Failure behavior (SURVEY.md §6 failure-detection row): handler exceptions
+return 500 with the error type and are counted; the process stays up.
+``POST /shutdown`` drains and stops (used by the deploy controller).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from lambdipy_tpu.runtime.loader import BootReport, load_bundle
+from lambdipy_tpu.runtime.metrics import LatencyStats
+from lambdipy_tpu.utils.logs import get_logger, log_event
+
+log = get_logger("lambdipy.server")
+
+
+class BundleServer:
+    def __init__(self, bundle_dir: Path, host: str = "127.0.0.1", port: int = 0,
+                 *, warmup: bool = True):
+        self.bundle_dir = Path(bundle_dir)
+        self.stats = LatencyStats()
+        self.started = time.time()
+        self.boot: BootReport = load_bundle(self.bundle_dir, warmup=warmup)
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- request handling ---------------------------------------------------
+
+    def _make_handler(server_self):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through structured logs
+                log.debug(fmt % args)
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {
+                        "ok": True,
+                        "bundle": str(server_self.bundle_dir),
+                        "uptime_s": round(time.time() - server_self.started, 1),
+                        "cold_start": server_self.boot.stages,
+                        "skew": server_self.boot.skew,
+                        "handler_meta": getattr(server_self.boot.state, "meta", {}),
+                    })
+                elif self.path == "/metrics":
+                    self._send(200, server_self.stats.report())
+                else:
+                    self._send(404, {"ok": False, "error": "not found"})
+
+            def do_POST(self):
+                if self.path == "/shutdown":
+                    self._send(200, {"ok": True, "draining": True})
+                    threading.Thread(target=server_self.stop, daemon=True).start()
+                    return
+                if self.path != "/invoke":
+                    self._send(404, {"ok": False, "error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    request = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    server_self.stats.record_error()
+                    self._send(400, {"ok": False, "error": f"bad request: {e}"})
+                    return
+                t0 = time.monotonic()
+                try:
+                    result = server_self.boot.handler.invoke(
+                        server_self.boot.state, request)
+                except Exception as e:  # handler bug or bad payload shape
+                    server_self.stats.record_error()
+                    log_event(log, "invoke failed", error=str(e),
+                              kind=type(e).__name__)
+                    self._send(500, {"ok": False, "error": str(e),
+                                     "kind": type(e).__name__})
+                    return
+                server_self.stats.record((time.monotonic() - t0) * 1e3)
+                self._send(200, result)
+
+        return Handler
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def serve_forever(self):
+        log_event(log, "serving", port=self.port, bundle=str(self.bundle_dir))
+        self._httpd.serve_forever()
+
+    def start_background(self):
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv=None) -> int:
+    """``python -m lambdipy_tpu.runtime.server <bundle_dir> [port]``"""
+    import sys
+
+    import os
+
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: server <bundle_dir> [port]", file=sys.stderr)
+        return 2
+    # Platform override via our own env var: JAX_PLATFORMS=cpu at interpreter
+    # start hangs this image's axon sitecustomize (see tests/conftest.py), so
+    # the deploy controller passes LAMBDIPY_PLATFORM and we switch after
+    # startup, before the backend initializes.
+    platform = os.environ.get("LAMBDIPY_PLATFORM")
+    if platform:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", platform)
+        except Exception as e:
+            log.warning("platform override %r failed: %s", platform, e)
+    bundle = Path(argv[0])
+    port = int(argv[1]) if len(argv) > 1 else 0
+    server = BundleServer(bundle, port=port)
+    # readiness line on stdout: the deploy controller parses this
+    print(json.dumps({"ready": True, "port": server.port,
+                      "cold_start": server.boot.stages}), flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,13 @@
+"""Training: sharded train-step builder.
+
+The reference is a packaging tool and never trains anything; this exists
+because the rebuild's model payloads are first-class (BASELINE.json configs
+3-5) and fine-tuning/continued-pretraining on TPU slices is part of the
+framework's scope. One design: params sharded by rule set (FSDP over the
+data axes + TP), batch sharded over dp, sequence over sp, optimizer state
+sharded like params, XLA inserting all collectives.
+"""
+
+from lambdipy_tpu.train.step import TrainState, make_train_step, train_shardings
+
+__all__ = ["TrainState", "make_train_step", "train_shardings"]

@@ -2,20 +2,32 @@
 
 Runs the suite on a virtual 8-device CPU mesh (SURVEY.md §5.4): multi-chip
 mesh/pjit/collective logic is exercised without TPU hardware and the same
-code runs unmodified on a real slice. Environment must be set before jax is
-first imported, hence the module-level assignments here.
+code runs unmodified on a real slice.
+
+Environment quirk (measured, important): this machine's axon sitecustomize
+preloads jax and registers the TPU PJRT plugin at *interpreter start*, and
+starting the interpreter with ``JAX_PLATFORMS=cpu`` makes that registration
+hang. So the env var must NOT be set here (pytest started under the shell's
+``JAX_PLATFORMS=axon``); instead the platform is switched to CPU after
+startup via ``jax.config.update`` — backends have not initialized yet at
+conftest-import time, so the switch is effective and the axon plugin is
+never initialized.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA flags are read at first backend initialization, which happens after
+# conftest import — safe to set here.
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# keep test compiles fast and deterministic
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402  (already preloaded by sitecustomize)
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -29,8 +41,6 @@ def tmp_registry(tmp_path):
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"
     return devices

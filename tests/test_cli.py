@@ -1,0 +1,82 @@
+"""CLI tests (click CliRunner) over the end-to-end build->deploy surface."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from lambdipy_tpu.cli import main
+
+
+@pytest.fixture()
+def tiny_recipe_dir(tmp_path):
+    d = tmp_path / "recipes"
+    d.mkdir()
+    (d / "tiny-llm.toml").write_text(
+        'schema = 1\nname = "tiny-llm"\nversion = "0.1"\ndevice = "any"\n'
+        'base_layer = "jax-tpu"\nrequires = []\n'
+        "[payload]\n"
+        'model = "llama-tiny"\n'
+        'handler = "lambdipy_tpu.runtime.handlers:generate_handler"\n'
+        'params = "init"\ndtype = "float32"\n')
+    return d
+
+
+def test_recipes_listing(tiny_recipe_dir):
+    result = CliRunner().invoke(main, ["recipes", "--recipe-dir", str(tiny_recipe_dir)])
+    assert result.exit_code == 0, result.output
+    assert "jax-resnet50" in result.output and "tiny-llm" in result.output
+
+
+def test_show_recipe():
+    result = CliRunner().invoke(main, ["show", "jax-llama3-8b"])
+    assert result.exit_code == 0
+    doc = json.loads(result.output)
+    assert doc["payload"]["quant"] == "int8"
+
+
+def test_show_unknown_recipe_fails_cleanly():
+    result = CliRunner().invoke(main, ["show", "nope"])
+    assert result.exit_code != 0
+    assert "no recipe named" in str(result.exception)
+
+
+def test_build_publish_cache_hit_and_artifacts(tiny_recipe_dir, tmp_path):
+    runner = CliRunner()
+    reg = str(tmp_path / "registry")
+    args = ["build", "tiny-llm", "--recipe-dir", str(tiny_recipe_dir),
+            "--registry", reg]
+    r1 = runner.invoke(main, args)
+    assert r1.exit_code == 0, r1.output
+    assert "built + published" in r1.output
+    r2 = runner.invoke(main, args)
+    assert "cache hit" in r2.output
+    r3 = runner.invoke(main, ["artifacts", "--registry", reg])
+    assert "tiny-llm-0.1" in r3.output
+
+
+def test_build_to_out_dir(tiny_recipe_dir, tmp_path):
+    out = tmp_path / "bundle"
+    r = CliRunner().invoke(main, [
+        "build", "tiny-llm", "--recipe-dir", str(tiny_recipe_dir),
+        "--out", str(out)])
+    assert r.exit_code == 0, r.output
+    assert (out / "manifest.json").exists()
+    assert (out / "params" / "orbax").exists()
+    assert (out / "handler.py").exists()
+
+
+def test_package_command(tmp_path):
+    req = tmp_path / "requirements.txt"
+    req.write_text("einops\n")
+    out = tmp_path / "build"
+    r = CliRunner().invoke(main, ["package", str(req), "--out", str(out)])
+    assert r.exit_code == 0, r.output
+    assert (out / "site" / "einops").is_dir()
+
+
+def test_deploy_rejects_unknown_target(tmp_path):
+    r = CliRunner().invoke(main, ["deploy", "definitely-missing",
+                                  "--registry", str(tmp_path / "reg")])
+    assert r.exit_code != 0
+    assert "neither a bundle dir" in r.output

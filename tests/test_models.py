@@ -1,0 +1,153 @@
+"""Model family tests on the virtual CPU mesh (SURVEY.md §5 plan items 3-4:
+numerics + mesh logic without hardware)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models import registry
+
+
+def test_registry_lists_required_models():
+    for name in ["resnet50", "bert-base", "llama3-8b", "tabular", "bert-base-torch"]:
+        assert name in registry.names()
+
+
+def test_registry_unknown_model():
+    with pytest.raises(registry.ModelError, match="unknown model"):
+        registry.get("gpt-17")
+
+
+def test_resnet_tiny_forward():
+    adapter = registry.get("resnet50-tiny").build()
+    params = adapter.init_params(seed=0)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    logits = jax.jit(adapter.forward)(params, x)
+    assert logits.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_bert_tiny_forward_mask_matters():
+    adapter = registry.get("bert-tiny").build()
+    params = adapter.init_params(seed=0)
+    cfg = adapter.config
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, cfg.max_len)), jnp.int32)
+    full = jnp.ones((2, cfg.max_len), jnp.int32)
+    half = full.at[:, cfg.max_len // 2:].set(0)
+    out_full = jax.jit(adapter.forward)(params, ids, full)
+    out_half = jax.jit(adapter.forward)(params, ids, half)
+    assert out_full.shape == (2, cfg.num_classes)
+    assert not np.allclose(np.asarray(out_full), np.asarray(out_half))
+
+
+def test_llama_tiny_prefill_decode_consistency():
+    """Teacher-forced prefill logits must match step-by-step decode logits —
+    the KV-cache correctness invariant."""
+    adapter = registry.get("llama-tiny").build()
+    module = adapter.module
+    params = adapter.init_params(seed=0)
+    cfg = adapter.config
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 8)), jnp.int32)
+
+    full_logits, _ = module.apply(params, tokens)
+
+    from lambdipy_tpu.models.llama import init_decode_cache
+
+    cache = init_decode_cache(cfg, batch=1, max_len=16)
+    step_logits = []
+    for t in range(8):
+        positions = jnp.full((1, 1), t, jnp.int32)
+        logits, cache = module.apply(params, tokens[:, t:t + 1],
+                                     positions=positions, cache=cache)
+        for entry in cache:
+            entry["index"] = jnp.int32(t + 1)
+        step_logits.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(
+        np.asarray(full_logits[0]), np.stack(step_logits, 1)[0],
+        rtol=2e-4, atol=2e-4)
+
+
+def test_llama_greedy_generate_shapes_and_determinism():
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out1 = adapter.generate(params, prompt, max_new_tokens=6)
+    out2 = adapter.generate(params, prompt, max_new_tokens=6)
+    assert out1.shape == (1, 6)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_llama_int8_quantize_params_close_to_float():
+    import dataclasses
+
+    from lambdipy_tpu.models.llama import LLAMA_TINY, LlamaModel, quantize_params
+
+    cfg_f = LLAMA_TINY
+    cfg_q = dataclasses.replace(LLAMA_TINY, quant="int8")
+    model_f = LlamaModel(cfg_f)
+    model_q = LlamaModel(cfg_q)
+    tokens = jnp.asarray([[5, 6, 7]], jnp.int32)
+    params_f = model_f.init(jax.random.PRNGKey(0), tokens)
+    params_q = quantize_params(params_f)
+    logits_f, _ = model_f.apply(params_f, tokens)
+    logits_q, _ = model_q.apply(params_q, tokens)
+    # int8 weight-only quant should track float logits closely on a tiny net
+    err = np.max(np.abs(np.asarray(logits_f) - np.asarray(logits_q)))
+    scale = np.max(np.abs(np.asarray(logits_f))) + 1e-6
+    assert err / scale < 0.1, f"relative error {err / scale}"
+
+
+def test_llama_tp_sharded_forward_matches_single_device(cpu_devices):
+    """TP=4 sharded forward must be numerically identical (up to fp tolerance)
+    to the unsharded run — XLA inserts the collectives (SURVEY.md §3.2)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lambdipy_tpu.parallel.mesh import make_mesh
+    from lambdipy_tpu.parallel.sharding import param_shardings, shard_params
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    tokens = jnp.asarray(np.random.default_rng(2).integers(0, 500, (2, 8)), jnp.int32)
+    ref = np.asarray(adapter.forward(params, tokens))
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    sharded_params = shard_params(params, mesh, adapter.tp_rules)
+    shardings = param_shardings(params, mesh, adapter.tp_rules)
+    fwd = jax.jit(adapter.forward,
+                  in_shardings=(shardings, NamedSharding(mesh, P("dp"))),
+                  out_shardings=NamedSharding(mesh, P("dp")))
+    with mesh:
+        out = fwd(sharded_params, jax.device_put(tokens, NamedSharding(mesh, P("dp"))))
+    np.testing.assert_allclose(ref, np.asarray(out), rtol=2e-3, atol=2e-3)
+
+
+def test_save_and_load_params_roundtrip_jax(tmp_path):
+    info = registry.save_init_params("llama-tiny", tmp_path / "p", dtype="float32")
+    assert info["format"] == "orbax" and info["n_params"] > 0
+    params = registry.load_params("llama-tiny", tmp_path / "p")
+    adapter = registry.get("llama-tiny").build()
+    logits = adapter.forward(params, jnp.asarray([[1, 2]], jnp.int32))
+    assert logits.shape[-1] == adapter.config.vocab_size
+
+
+def test_save_and_load_params_sklearn(tmp_path):
+    info = registry.save_init_params("tabular", tmp_path / "p")
+    assert info["format"] == "joblib"
+    clf = registry.load_params("tabular", tmp_path / "p")
+    preds = clf.predict(np.zeros((3, info["n_features"])))
+    assert preds.shape == (3,)
+
+
+def test_torch_bert_cpu_smoke(tmp_path):
+    import torch
+
+    built = registry.get("bert-base-torch").build(
+        extra={"hidden": 32, "layers": 1, "heads": 2, "vocab_size": 100, "max_len": 16})
+    model = built["make_model"]()
+    with torch.no_grad():
+        out = model(torch.zeros(2, 16, dtype=torch.long),
+                    torch.ones(2, 16, dtype=torch.long))
+    assert out.shape == (2, 2)

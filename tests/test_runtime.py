@@ -1,0 +1,142 @@
+"""Serve-runtime integration: bundle boot, HTTP loop, deploy controller
+(SURVEY.md §4 E — the rebuild's #1 new call stack; §6 failure rows)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lambdipy_tpu.buildengine import build_recipe
+from lambdipy_tpu.bundle import assemble_bundle
+from lambdipy_tpu.recipes.schema import load_recipe_dict
+
+
+def make_model_bundle(tmp_path, *, model="llama-tiny", handler, extra=None,
+                      mesh=None):
+    """Build a tiny model bundle end-to-end (vendor nothing; base layer
+    provides jax; payload params initialized at build time)."""
+    doc = {
+        "schema": 1,
+        "name": f"test-{model}",
+        "version": "0.1",
+        "device": "any",
+        "base_layer": "jax-tpu",
+        "requires": [],
+        "payload": {
+            "model": model,
+            "handler": handler,
+            "params": "init",
+            "dtype": "float32",
+            **({"mesh": mesh} if mesh else {}),
+            **({"extra": extra} if extra else {}),
+        },
+    }
+    recipe = load_recipe_dict(doc)
+    result = build_recipe(recipe, tmp_path / "work", run_smoke=False)
+    out = tmp_path / "bundle"
+    assemble_bundle(result, out, with_payload=True)
+    return out
+
+
+@pytest.fixture(scope="module")
+def llama_bundle(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("llama-bundle")
+    return make_model_bundle(
+        tmp, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "4"})
+
+
+def test_load_bundle_and_invoke(llama_bundle):
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    report = load_bundle(llama_bundle, warmup=True)
+    assert report.warmup_result["ok"]
+    assert {"manifest", "syspath", "compile_cache", "handler_import",
+            "init", "warmup"} <= set(report.stages)
+    out = report.handler.invoke(report.state, {"tokens": [1, 2, 3]})
+    assert out["ok"] and out["n_new"] == 4
+    assert (llama_bundle / "compile_cache").is_dir()
+
+
+def test_resnet_bundle_image_handler(tmp_path):
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="resnet50-tiny",
+        handler="lambdipy_tpu.runtime.handlers:image_classify_handler")
+    report = load_bundle(bundle)
+    out = report.handler.invoke(report.state, {"random": True})
+    assert out["ok"] and len(out["top5"][0]) == 5
+
+
+def test_hello_bundle_without_params(tmp_path):
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="hello",
+        handler="lambdipy_tpu.runtime.handlers:hello_handler")
+    report = load_bundle(bundle)
+    out = report.handler.invoke(report.state, {"n": 16, "seed": 7})
+    assert out["ok"] and isinstance(out["logdet"], float)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_http_server_full_loop(llama_bundle):
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    server = BundleServer(llama_bundle, port=0).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        health = _get(f"{base}/healthz")
+        assert health["ok"] and "init" in health["cold_start"]
+        out = _post(f"{base}/invoke", {"tokens": [1, 2, 3], "max_new_tokens": 2})
+        assert out["ok"] and out["n_new"] == 2
+        metrics = _get(f"{base}/metrics")
+        assert metrics["count"] >= 1 and metrics["p50_ms"] > 0
+        # failure detection: bad payload shape -> 500, counted, server alive
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/invoke", {"tokens": "not-a-list"})
+        assert e.value.code == 500
+        assert _get(f"{base}/metrics")["errors"] >= 1
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}/nope")
+        assert e.value.code == 404
+        assert _get(f"{base}/healthz")["ok"]  # still alive
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_local_deploy_subprocess_lifecycle(llama_bundle, tmp_path):
+    """Full deploy path: subprocess server (CPU via LAMBDIPY_PLATFORM),
+    readiness, invoke over HTTP, watchdog health, drain + stop."""
+    from lambdipy_tpu.runtime.deploy import DeployError, LocalRuntime
+
+    rt = LocalRuntime(tmp_path / "deployments.json")
+    dep = rt.deploy("t1", llama_bundle, env={
+        "LAMBDIPY_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    })
+    try:
+        assert rt.health("t1")["ok"]
+        out = rt.invoke("t1", {"tokens": [1, 2], "max_new_tokens": 2})
+        assert out["ok"]
+        with pytest.raises(DeployError, match="already exists"):
+            rt.deploy("t1", llama_bundle)
+        assert [d.name for d in rt.list()] == ["t1"]
+    finally:
+        rt.stop("t1")
+    assert rt.list() == []

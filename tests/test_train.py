@@ -1,0 +1,48 @@
+"""Sharded train-step tests on the virtual CPU mesh (SURVEY.md §5.4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from lambdipy_tpu.models import registry
+from lambdipy_tpu.parallel.mesh import make_mesh
+from lambdipy_tpu.train.step import sharded_train_step
+
+
+def test_sharded_train_step_runs_and_loss_decreases(cpu_devices):
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+    with mesh:
+        step, state, batch_sharding = sharded_train_step(
+            adapter.forward, params, mesh, adapter.tp_rules, learning_rate=5e-3)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 500, (4, 16)), jnp.int32)
+        import jax
+
+        tokens = jax.device_put(tokens, batch_sharding)
+        state, m0 = step(state, tokens)
+        first = float(m0["loss"])
+        for _ in range(5):
+            state, m = step(state, tokens)
+        assert np.isfinite(first) and float(m["grad_norm"]) > 0
+        assert float(m["loss"]) < first  # memorizing a fixed batch
+        assert int(jax.device_get(state.step)) == 6
+
+
+def test_fsdp_params_actually_sharded(cpu_devices):
+    import jax
+    from jax.sharding import NamedSharding
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    with mesh:
+        _, state, _ = sharded_train_step(
+            adapter.forward, params, mesh, adapter.tp_rules)
+    specs = {
+        "/".join(str(getattr(k, "key", k)) for k in path): leaf.sharding.spec
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state.params)
+        if isinstance(leaf.sharding, NamedSharding)
+    }
+    # at least one kernel carries both dp (fsdp) and tp axes
+    assert any("dp" in str(s) and "tp" in str(s) for s in specs.values()), specs
