@@ -78,7 +78,10 @@ def artifacts_cmd(registry_dir):
 @click.option("--no-smoke", is_flag=True, help="skip the hermetic import smoke")
 @click.option("--no-payload", is_flag=True, help="skip params/handler materialization")
 @click.option("--force", is_flag=True, help="rebuild even if the artifact is cached")
-def build_cmd(recipe_name, out, registry_dir, recipe_dir, no_smoke, no_payload, force):
+@click.option("--warm/--no-warm", default=True,
+              help="pre-populate the bundle's XLA compile cache (model recipes)")
+def build_cmd(recipe_name, out, registry_dir, recipe_dir, no_smoke, no_payload,
+              force, warm):
     """Build a recipe into a bundle and publish it to the local registry
     (cache-hit short-circuits like the reference's prebuilt fetch)."""
     from lambdipy_tpu.buildengine import build_recipe
@@ -99,8 +102,28 @@ def build_cmd(recipe_name, out, registry_dir, recipe_dir, no_smoke, no_payload, 
     workdir = Path(tempfile.mkdtemp(prefix=f"lambdipy-build-{recipe.name}-"))
     result = build_recipe(recipe, workdir, run_smoke=not no_smoke)
     bundle_dir = Path(out) if out else workdir / "bundle"
-    manifest = assemble_bundle(result, bundle_dir,
-                               with_payload=not no_payload and recipe.is_model)
+    with_payload = not no_payload and recipe.is_model
+    manifest = assemble_bundle(result, bundle_dir, with_payload=with_payload)
+    if warm and with_payload:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        repo_root = str(Path(__file__).resolve().parents[1])
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+        # warm on the device the recipe targets: cpu/any recipes must not
+        # touch (or wait on) the TPU; tpu recipes use the shell's platform
+        if "LAMBDIPY_PLATFORM" not in env and not recipe.device.startswith("tpu"):
+            env["LAMBDIPY_PLATFORM"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "lambdipy_tpu.runtime.warm", str(bundle_dir)],
+            capture_output=True, text=True, env=env, timeout=1800)
+        if proc.returncode == 0:
+            click.echo(f"warmed: {proc.stdout.strip().splitlines()[-1]}")
+        else:
+            click.echo(f"warning: warm failed (bundle still usable): "
+                       f"{proc.stderr.strip()[-300:]}", err=True)
     if out is None:
         registry.publish(artifact_id, bundle_dir, recipe=recipe.name,
                          version=recipe.version, device=recipe.device,

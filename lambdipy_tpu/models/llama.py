@@ -38,6 +38,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     quant: str | None = None  # None | "int8"
+    # Prefill attention backend: "dense" (XLA-fused, default), "flash"
+    # (Pallas kernel when shapes tile), or "ring" (sequence-parallel ring
+    # attention over the ambient mesh's sp axis — the long-context path).
+    attn_backend: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -130,6 +134,30 @@ def _attend(q, k, v, mask):
 class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
+    def _prefill_attend(self, q, k, v, mask):
+        """Causal prefill attention via the configured backend."""
+        cfg = self.cfg
+        s = q.shape[1]
+        backend = cfg.attn_backend
+        if backend == "ring":
+            from lambdipy_tpu.parallel.mesh import current_mesh
+            from lambdipy_tpu.parallel.ring import ring_attention
+
+            mesh = current_mesh()
+            if mesh is not None and mesh.shape.get("sp", 1) > 1:
+                # sequence-parallel long-context path; padding mask is
+                # carried by the causal structure (callers pad right and
+                # ignore tail logits)
+                return ring_attention(q, k, v, mesh, causal=True)
+            backend = "dense"  # no sp axis -> fall through
+        if backend == "flash":
+            from lambdipy_tpu.ops.attention import flash_attention
+
+            return flash_attention(q, k, v, causal=True)
+        causal = jnp.tril(jnp.ones((s, s), dtype=jnp.bool_))
+        attn_mask = mask[:, None, :] & causal[None, :, :]
+        return _attend(q, k, v, attn_mask)
+
     @nn.compact
     def __call__(self, x, positions, mask, cache):
         """cache: None (prefill over full x) or dict(k, v, index) for decode.
@@ -147,10 +175,7 @@ class LlamaBlock(nn.Module):
         q, k = rope(q, k, positions, cfg.rope_theta)
 
         if cache is None:
-            # prefill: causal mask over the full sequence
-            causal = jnp.tril(jnp.ones((s, s), dtype=jnp.bool_))
-            attn_mask = mask[:, None, :] & causal[None, :, :]
-            out = _attend(q, k, v, attn_mask)
+            out = self._prefill_attend(q, k, v, mask)
             new_cache = {"k": k, "v": v}
         else:
             # decode: append this step's k/v at cache index, attend over prefix

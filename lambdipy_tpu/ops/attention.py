@@ -83,9 +83,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
 def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False):
+                    interpret: bool | None = None):
     """Flash attention. q/k/v: [b, s, h, d]; kv heads broadcast for GQA.
-    Falls back to the reference when shapes don't tile (tiny test configs)."""
+    Falls back to the reference when shapes don't tile (tiny test configs).
+    ``interpret=None`` auto-selects interpret mode on the CPU backend
+    (Mosaic compiles only for TPU)."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kvh = k.shape[2]

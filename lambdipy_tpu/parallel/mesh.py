@@ -14,13 +14,47 @@ ICI, per the scaling-book recipe):
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import math
+import warnings
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 MESH_AXES: tuple[str, ...] = ("dp", "fsdp", "pp", "tp", "sp", "ep")
+
+_ACTIVE_MESH: contextvars.ContextVar[Mesh | None] = contextvars.ContextVar(
+    "lambdipy_active_mesh", default=None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Enter a mesh for both jax (``with mesh``) and framework consumers
+    (:func:`current_mesh` — e.g. models picking a ring-attention backend)."""
+    token = _ACTIVE_MESH.set(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _ACTIVE_MESH.reset(token)
+
+
+def current_mesh() -> Mesh | None:
+    """The ambient mesh: ours first, then jax's legacy with-mesh context."""
+    mesh = _ACTIVE_MESH.get()
+    if mesh is not None:
+        return mesh
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            from jax.interpreters import pxla
+
+            phys = pxla.thread_resources.env.physical_mesh
+        return phys if phys.axis_names else None
+    except Exception:
+        return None
 
 
 def make_mesh(shape: dict[str, int], devices=None) -> Mesh:

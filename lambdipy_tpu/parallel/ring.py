@@ -1,0 +1,111 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context attention where the sequence is sharded across devices and
+K/V blocks rotate around the ring via ``lax.ppermute`` (one ICI hop per
+step) while each device accumulates online-softmax partial results for its
+local Q block — compute overlaps the rotation, full attention is recovered
+exactly, and no device ever materializes more than (s/sp)^2 scores. This is
+the blockwise/ring formulation (Liu et al.) expressed the TPU way:
+``shard_map`` + XLA collectives over the mesh, not a hand-rolled transport
+(SURVEY.md §3.2, §6 long-context row).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, mask, scale):
+    """One blockwise attention contribution. q: [b,sq,h,d]; k/v: [b,sk,h,d];
+    mask: [sq, sk] bool or None. Returns (m, l, acc) partials in f32."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [b,h,q]
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would give 1s
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)  # [b,h,q]
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_safe, l, acc
+
+
+def _combine(m1, l1, acc1, m2, l2, acc2):
+    """Merge two online-softmax partials."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    # broadcast [b,h,q] coefficients onto [b,q,h,d] accumulators
+    def bcast(a):
+        return jnp.transpose(a, (0, 2, 1))[..., None]
+    acc = acc1 * bcast(a1) + acc2 * bcast(a2)
+    return m, l, acc
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
+                          vary_axes: tuple[str, ...] = ()):
+    """Per-shard body (runs inside shard_map). q/k/v: [b, s_local, h, d]."""
+    sp = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+
+    causal_block = jnp.tril(jnp.ones((sq, sq), jnp.bool_)) if causal else None
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    # mark the initial accumulators as varying over the ring axis so the
+    # scan carry type matches its device-varying outputs (jax vma tracking)
+    def varying(x):
+        return jax.lax.pcast(x, vary_axes or (axis_name,), to="varying")
+
+    m0 = varying(jnp.full((b, h, sq), NEG_INF, jnp.float32))
+    l0 = varying(jnp.zeros((b, h, sq), jnp.float32))
+    acc0 = varying(jnp.zeros((b, sq, h, d), jnp.float32))
+
+    def step(carry, i):
+        m, l, acc, kb, vb = carry
+        src = (my - i) % sp  # which global block this kv currently is
+        if causal:
+            # src < my: fully visible; src == my: causal; src > my: skip
+            mask = jnp.where(src < my, jnp.ones((sq, sq), jnp.bool_),
+                             jnp.where(src == my, causal_block,
+                                       jnp.zeros((sq, sq), jnp.bool_)))
+        else:
+            mask = None
+        bm, bl, bacc = _block_attend(q, kb, vb, mask, scale)
+        m, l, acc = _combine(m, l, acc, bm, bl, bacc)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (m, l, acc, kb, vb), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(sp))
+    l = jnp.maximum(l, 1e-30)
+    out = acc / jnp.transpose(l, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
+                   causal: bool = True, scale: float | None = None):
+    """Full attention over sequence-sharded q/k/v: [b, s, h, d] with the
+    ``s`` dim sharded over ``axis``. GQA kv heads are broadcast first."""
+    h, kvh = q.shape[2], k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    spec = P(batch_axes if batch_axes else None, axis, None, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_local, axis_name=axis, causal=causal, scale=scale,
+                vary_axes=batch_axes + (axis,)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,58 @@
+"""Build-time bundle warming: pre-populate the persistent compile cache.
+
+Cold start is interpreter + PJRT init + first compile (BASELINE.md: ~10 s
+floor measured, first jit 0.67 s for a trivial op, tens of seconds for real
+models). The builder runs this module as a subprocess against the freshly
+assembled bundle (same interpreter/platform as the serve runtime), so the
+XLA compilation cache the bundle ships is already hot and the serve boot's
+"first" compile is a cache hit — SURVEY.md §9.6: "persistent compilation
+cache shipped *inside* the bundle".
+
+Usage: ``python -m lambdipy_tpu.runtime.warm <bundle_dir>``
+(honors LAMBDIPY_PLATFORM like the server).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def warm_bundle(bundle_dir: Path) -> dict:
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    t0 = time.monotonic()
+    report = load_bundle(Path(bundle_dir), warmup=True)
+    out = {
+        "warmed": True,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "stages": report.stages,
+        "cache_entries": sum(1 for _ in (Path(bundle_dir) / "compile_cache").rglob("*")
+                             if _.is_file()) if (Path(bundle_dir) / "compile_cache").is_dir() else 0,
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    import os
+
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: warm <bundle_dir>", file=sys.stderr)
+        return 2
+    platform = os.environ.get("LAMBDIPY_PLATFORM")
+    if platform:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass
+    print(json.dumps(warm_bundle(Path(argv[0]))), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

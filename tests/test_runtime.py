@@ -4,6 +4,7 @@
 import json
 import urllib.error
 import urllib.request
+from pathlib import Path
 
 import pytest
 
@@ -140,3 +141,35 @@ def test_local_deploy_subprocess_lifecycle(llama_bundle, tmp_path):
     finally:
         rt.stop("t1")
     assert rt.list() == []
+
+
+@pytest.mark.slow
+def test_warm_populates_compile_cache_and_speeds_boot(tmp_path):
+    """SURVEY.md §9.6: the bundle ships a warm XLA compile cache; a second
+    boot's warmup must hit it (no recompile)."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    bundle = make_model_bundle(
+        tmp_path, model="resnet50-tiny",
+        handler="lambdipy_tpu.runtime.handlers:image_classify_handler")
+    env = dict(os.environ)
+    env["LAMBDIPY_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    repo_root = str(Path(__file__).resolve().parents[1])
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    r1 = subprocess.run(
+        [_sys.executable, "-m", "lambdipy_tpu.runtime.warm", str(bundle)],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r1.returncode == 0, r1.stderr
+    out1 = json.loads(r1.stdout.strip().splitlines()[-1])
+    assert out1["cache_entries"] > 0
+    # second warm run: compile stage should hit the shipped cache
+    r2 = subprocess.run(
+        [_sys.executable, "-m", "lambdipy_tpu.runtime.warm", str(bundle)],
+        capture_output=True, text=True, env=env, timeout=600)
+    out2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert out2["stages"]["warmup"] + out2["stages"]["init"] < \
+        out1["stages"]["warmup"] + out1["stages"]["init"]
