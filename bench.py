@@ -1,27 +1,37 @@
 """Driver benchmark: flagship serving latency on the real chip.
 
-Measures ResNet-50 bf16 batch-1 forward p50 on the attached TPU (the
-BASELINE.json north-star metric: <15 ms p50 on v5e-1) and prints ONE JSON
-line. ``vs_baseline`` is the speedup vs the 15 ms target (>1 = beating it).
+Measures ResNet-50 bf16 batch-1 forward p50 (the BASELINE.json north-star
+metric: <15 ms p50 on v5e-1) and prints ONE JSON line; ``vs_baseline`` is
+the speedup vs the 15 ms target (>1 = beating it).
 
-Run with the shell's default env (JAX_PLATFORMS=axon -> the real chip);
-falls back to whatever backend initializes (and reports which) so the
-benchmark never crashes outright on a CPU-only machine.
+Robustness: the measurement runs in a subprocess because this image's TPU
+tunnel can wedge ``jax.devices()`` indefinitely (observed; see
+tests/conftest.py for the related sitecustomize hang). On timeout the
+orchestrator retries on CPU so the driver always gets a valid JSON line,
+with ``platform`` recording what was actually measured.
 """
 
 from __future__ import annotations
 
 import json
-import statistics
+import os
+import subprocess
 import sys
 import time
 
 BASELINE_P50_MS = 15.0  # BASELINE.json north star for ResNet-50 on v5e-1
+DEVICE_TIMEOUT_S = float(os.environ.get("LAMBDIPY_BENCH_TIMEOUT", "1500"))
 
 
-def main() -> int:
+def _inner() -> int:
+    import statistics
+
     t0 = time.monotonic()
+    platform_override = os.environ.get("LAMBDIPY_PLATFORM")
     import jax
+
+    if platform_override:
+        jax.config.update("jax_platforms", platform_override)
     import jax.numpy as jnp
 
     from lambdipy_tpu.models import registry
@@ -39,7 +49,6 @@ def main() -> int:
     jax.block_until_ready(fwd(params, x))
     compile_s = time.monotonic() - t1
 
-    # warmup then timed p50
     for _ in range(5):
         jax.block_until_ready(fwd(params, x))
     times = []
@@ -61,6 +70,41 @@ def main() -> int:
         "first_compile_s": round(compile_s, 2),
     }))
     return 0
+
+
+def main() -> int:
+    if "--inner" in sys.argv:
+        return _inner()
+    here = os.path.dirname(os.path.abspath(__file__))
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        [here] + [p for p in base_env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    attempts = [({}, DEVICE_TIMEOUT_S)]
+    if not os.environ.get("LAMBDIPY_PLATFORM"):
+        attempts.append(({"LAMBDIPY_PLATFORM": "cpu"}, 600.0))
+    last_err = ""
+    for extra_env, timeout in attempts:
+        env = dict(base_env)
+        env.update(extra_env)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py"), "--inner"],
+                capture_output=True, text=True, env=env, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            last_err = f"timeout after {timeout}s (device unreachable?)"
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            print(proc.stdout.strip().splitlines()[-1])
+            return 0
+        last_err = proc.stderr.strip()[-500:]
+    print(json.dumps({
+        "metric": "resnet50_b1_fwd_p50",
+        "value": -1.0,
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "error": last_err,
+    }))
+    return 1
 
 
 if __name__ == "__main__":

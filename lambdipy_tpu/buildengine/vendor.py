@@ -55,8 +55,9 @@ def import_names(dist: importlib.metadata.Distribution) -> list[str]:
                 names.add(parts[0].split(".")[0])
         else:
             names.add(parts[0])
-    # drop non-importable artifacts like "numpy.libs" (bundled .so dirs)
-    return sorted(n for n in names if n and "." not in n)
+    # drop non-importable artifacts: "numpy.libs" (bundled .so dirs),
+    # top-level __pycache__ from sloppy RECORDs
+    return sorted(n for n in names if n and "." not in n and n != "__pycache__")
 
 
 def dependency_closure(roots: list[str]) -> list[str]:

@@ -77,6 +77,13 @@ def verify_files(bundle_dir: Path, manifest: dict | None = None) -> list[str]:
             continue
         if path.stat().st_size != entry["size"]:
             problems.append(f"size mismatch: {entry['path']}")
-        elif hash_file(path) != entry["hash"]:
-            problems.append(f"hash mismatch: {entry['path']}")
+        else:
+            algo = entry["hash"].split(":", 1)[0]
+            try:
+                recomputed = hash_file(path, algo=algo)
+            except RuntimeError as e:  # algo unavailable (native ext not built)
+                problems.append(f"unverifiable ({e}): {entry['path']}")
+                continue
+            if recomputed != entry["hash"]:
+                problems.append(f"hash mismatch: {entry['path']}")
     return problems

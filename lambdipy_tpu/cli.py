@@ -233,9 +233,16 @@ def serve_cmd(bundle, port, registry_dir):
 @click.option("--data", default="{}", help="JSON request body")
 def invoke_cmd(name, data):
     """Invoke a deployed function."""
-    from lambdipy_tpu.runtime.deploy import LocalRuntime
+    from lambdipy_tpu.runtime.deploy import DeployError, LocalRuntime
 
-    click.echo(json.dumps(LocalRuntime().invoke(name, json.loads(data))))
+    try:
+        request = json.loads(data)
+    except json.JSONDecodeError as e:
+        raise click.ClickException(f"--data is not valid JSON: {e}") from e
+    try:
+        click.echo(json.dumps(LocalRuntime().invoke(name, request)))
+    except DeployError as e:
+        raise click.ClickException(str(e)) from e
 
 
 @main.command("deployments")
@@ -251,9 +258,12 @@ def deployments_cmd():
 @click.argument("name")
 def stop_cmd(name):
     """Stop a deployment."""
-    from lambdipy_tpu.runtime.deploy import LocalRuntime
+    from lambdipy_tpu.runtime.deploy import DeployError, LocalRuntime
 
-    LocalRuntime().stop(name)
+    try:
+        LocalRuntime().stop(name)
+    except DeployError as e:
+        raise click.ClickException(str(e)) from e
     click.echo(f"stopped {name}")
 
 

@@ -33,6 +33,7 @@ class BundleServer:
                  *, warmup: bool = True):
         self.bundle_dir = Path(bundle_dir)
         self.stats = LatencyStats()
+        self._profile_lock = threading.Lock()
         self.started = time.time()
         self.boot: BootReport = load_bundle(self.bundle_dir, warmup=warmup)
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
@@ -71,7 +72,52 @@ class BundleServer:
                 else:
                     self._send(404, {"ok": False, "error": "not found"})
 
+            def _read_json(self) -> dict | None:
+                """Parse the request body; sends a 400 and returns None on
+                client errors."""
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                    return body
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"ok": False, "error": f"bad request: {e}"})
+                    return None
+
             def do_POST(self):
+                if self.path == "/profile":
+                    req = self._read_json()
+                    if req is None:
+                        return
+                    try:
+                        n = max(1, min(int(req.get("invokes", 3)), 100))
+                    except (TypeError, ValueError):
+                        self._send(400, {"ok": False,
+                                         "error": "invokes must be an integer"})
+                        return
+                    # capture a device trace around N warmup-shaped invokes;
+                    # serialized — concurrent start_trace calls would fail
+                    try:
+                        from lambdipy_tpu.utils.trace import (
+                            latest_trace_files,
+                            profile_trace,
+                        )
+
+                        out_dir = server_self.bundle_dir / "profiles" / str(int(time.time()))
+                        with server_self._profile_lock:
+                            with profile_trace(out_dir) as capture:
+                                for _ in range(n):
+                                    server_self.boot.handler.invoke(
+                                        server_self.boot.state, {"warmup": True})
+                        payload = {"ok": capture.started, "dir": str(out_dir),
+                                   "files": latest_trace_files(out_dir)}
+                        if capture.error:
+                            payload["error"] = capture.error
+                        self._send(200 if capture.started else 503, payload)
+                    except Exception as e:
+                        self._send(500, {"ok": False, "error": str(e)})
+                    return
                 if self.path == "/shutdown":
                     self._send(200, {"ok": True, "draining": True})
                     threading.Thread(target=server_self.stop, daemon=True).start()
@@ -79,12 +125,9 @@ class BundleServer:
                 if self.path != "/invoke":
                     self._send(404, {"ok": False, "error": "not found"})
                     return
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    request = json.loads(self.rfile.read(length) or b"{}")
-                except (ValueError, json.JSONDecodeError) as e:
+                request = self._read_json()
+                if request is None:
                     server_self.stats.record_error()
-                    self._send(400, {"ok": False, "error": f"bad request: {e}"})
                     return
                 t0 = time.monotonic()
                 try:

@@ -46,9 +46,18 @@ def _native_hasher():
         return None
 
 
-def hash_file(path: Path) -> str:
-    """Fast content hash for manifests: native xxh64 when built, sha256 otherwise."""
+def hash_file(path: Path, algo: str | None = None) -> str:
+    """Fast content hash for manifests: native xxh64 when built, sha256
+    otherwise. ``algo`` pins the algorithm (used when re-verifying a
+    manifest whose hashes were produced elsewhere)."""
+    if algo == "sha256":
+        return f"sha256:{sha256_file(path)}"
     native = _native_hasher()
+    if algo == "xxh64":
+        if native is None:
+            raise RuntimeError("manifest uses xxh64 but the native extension is not built; "
+                               "run: python setup_native.py build_ext --inplace")
+        return f"xxh64:{native(str(path)):016x}"
     if native is not None:
         return f"xxh64:{native(str(path)):016x}"
     return f"sha256:{sha256_file(path)}"

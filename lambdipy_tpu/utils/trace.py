@@ -1,0 +1,67 @@
+"""Tracing/profiling (SURVEY.md §6: absent in the reference; first-class
+here).
+
+Two layers:
+- :func:`profile_trace` — ``jax.profiler`` capture to a directory, viewable
+  with tensorboard-plugin-profile (the canonical TPU stack per the
+  jax-stable-stack image, SURVEY.md §3.4 ``jss:tpu/Dockerfile:94``). Used
+  by the serve loop's ``/profile`` endpoint and ad-hoc by benchmarks.
+- build/serve stage timing — :class:`lambdipy_tpu.utils.timing.StageTimer`
+  records per-stage wall time into manifests and /healthz.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from pathlib import Path
+
+
+class TraceCapture:
+    """Handle yielded by :func:`profile_trace`; ``started`` records whether
+    the profiler actually engaged (callers must surface this — an untraced
+    capture must not masquerade as a trace)."""
+
+    def __init__(self, out_dir: Path):
+        self.out_dir = Path(out_dir)
+        self.started = False
+        self.error: str | None = None
+
+
+@contextmanager
+def profile_trace(out_dir: Path):
+    """Capture a jax profiler trace into ``out_dir`` (xplane protos +
+    trace.json.gz). Never raises — serving must not die to tracing — but
+    the yielded :class:`TraceCapture` reports whether the profiler engaged
+    (it won't if jax is absent or another trace is already active)."""
+    capture = TraceCapture(out_dir)
+    capture.out_dir.mkdir(parents=True, exist_ok=True)
+    try:
+        import jax
+
+        jax.profiler.start_trace(str(capture.out_dir))
+        capture.started = True
+    except Exception as e:
+        capture.error = f"{type(e).__name__}: {e}"
+    t0 = time.monotonic()
+    try:
+        yield capture
+    finally:
+        if capture.started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:
+                capture.error = f"stop_trace: {type(e).__name__}: {e}"
+        (capture.out_dir / "capture_meta.json").write_text(
+            '{"wall_s": %.4f, "started": %s}'
+            % (time.monotonic() - t0, "true" if capture.started else "false"))
+
+
+def latest_trace_files(out_dir: Path) -> list[str]:
+    out_dir = Path(out_dir)
+    if not out_dir.is_dir():
+        return []
+    return sorted(str(p.relative_to(out_dir))
+                  for p in out_dir.rglob("*") if p.is_file())[:50]

@@ -1,0 +1,194 @@
+/* xxh64: fast non-cryptographic content hash for bundle manifests.
+ *
+ * The build engine hashes every file it stages (manifest integrity +
+ * registry dedup); for multi-GB TPU payloads (libtpu.so is 614 MB —
+ * SURVEY.md §3.3) sha256 in Python is the bottleneck, so the hot path is
+ * this C extension (XXH64, the public domain xxHash algorithm, implemented
+ * from the spec) with mmap-free chunked IO. Falls back to hashlib when the
+ * extension isn't built (lambdipy_tpu/utils/fsutil.py).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#define PRIME1 11400714785074694791ULL
+#define PRIME2 14029467366897019727ULL
+#define PRIME3 1609587929392839161ULL
+#define PRIME4 9650029242287828579ULL
+#define PRIME5 2870177450012600261ULL
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t read64(const uint8_t *p) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    return v; /* little-endian hosts only (x86-64/arm64 TPU VMs) */
+}
+
+static inline uint32_t read32(const uint8_t *p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+static inline uint64_t round1(uint64_t acc, uint64_t input) {
+    acc += input * PRIME2;
+    acc = rotl64(acc, 31);
+    acc *= PRIME1;
+    return acc;
+}
+
+static inline uint64_t merge_round(uint64_t acc, uint64_t val) {
+    val = round1(0, val);
+    acc ^= val;
+    acc = acc * PRIME1 + PRIME4;
+    return acc;
+}
+
+typedef struct {
+    uint64_t v1, v2, v3, v4;
+    uint64_t total_len;
+    uint8_t buf[32];
+    size_t buf_len;
+} xxh64_state;
+
+static void state_init(xxh64_state *s, uint64_t seed) {
+    s->v1 = seed + PRIME1 + PRIME2;
+    s->v2 = seed + PRIME2;
+    s->v3 = seed;
+    s->v4 = seed - PRIME1;
+    s->total_len = 0;
+    s->buf_len = 0;
+}
+
+static void state_update(xxh64_state *s, const uint8_t *p, size_t len) {
+    s->total_len += len;
+    if (s->buf_len + len < 32) {
+        memcpy(s->buf + s->buf_len, p, len);
+        s->buf_len += len;
+        return;
+    }
+    if (s->buf_len) {
+        size_t fill = 32 - s->buf_len;
+        memcpy(s->buf + s->buf_len, p, fill);
+        s->v1 = round1(s->v1, read64(s->buf));
+        s->v2 = round1(s->v2, read64(s->buf + 8));
+        s->v3 = round1(s->v3, read64(s->buf + 16));
+        s->v4 = round1(s->v4, read64(s->buf + 24));
+        p += fill;
+        len -= fill;
+        s->buf_len = 0;
+    }
+    while (len >= 32) {
+        s->v1 = round1(s->v1, read64(p));
+        s->v2 = round1(s->v2, read64(p + 8));
+        s->v3 = round1(s->v3, read64(p + 16));
+        s->v4 = round1(s->v4, read64(p + 24));
+        p += 32;
+        len -= 32;
+    }
+    if (len) {
+        memcpy(s->buf, p, len);
+        s->buf_len = len;
+    }
+}
+
+static uint64_t state_digest(const xxh64_state *s, uint64_t seed) {
+    uint64_t h;
+    if (s->total_len >= 32) {
+        h = rotl64(s->v1, 1) + rotl64(s->v2, 7) + rotl64(s->v3, 12) +
+            rotl64(s->v4, 18);
+        h = merge_round(h, s->v1);
+        h = merge_round(h, s->v2);
+        h = merge_round(h, s->v3);
+        h = merge_round(h, s->v4);
+    } else {
+        h = seed + PRIME5;
+    }
+    h += s->total_len;
+    const uint8_t *p = s->buf;
+    const uint8_t *end = s->buf + s->buf_len;
+    while (p + 8 <= end) {
+        h ^= round1(0, read64(p));
+        h = rotl64(h, 27) * PRIME1 + PRIME4;
+        p += 8;
+    }
+    if (p + 4 <= end) {
+        h ^= (uint64_t)read32(p) * PRIME1;
+        h = rotl64(h, 23) * PRIME2 + PRIME3;
+        p += 4;
+    }
+    while (p < end) {
+        h ^= (*p) * PRIME5;
+        h = rotl64(h, 11) * PRIME1;
+        p++;
+    }
+    h ^= h >> 33;
+    h *= PRIME2;
+    h ^= h >> 29;
+    h *= PRIME3;
+    h ^= h >> 32;
+    return h;
+}
+
+static PyObject *py_xxh64_file(PyObject *self, PyObject *args) {
+    const char *path;
+    unsigned long long seed = 0;
+    if (!PyArg_ParseTuple(args, "s|K", &path, &seed))
+        return NULL;
+    FILE *f = fopen(path, "rb");
+    if (!f)
+        return PyErr_SetFromErrnoWithFilename(PyExc_OSError, path);
+    xxh64_state st;
+    state_init(&st, seed);
+    size_t cap = 1 << 20;
+    uint8_t *buf = (uint8_t *)PyMem_Malloc(cap);
+    if (!buf) {
+        fclose(f);
+        return PyErr_NoMemory();
+    }
+    size_t n;
+    Py_BEGIN_ALLOW_THREADS
+    while ((n = fread(buf, 1, cap, f)) > 0)
+        state_update(&st, buf, n);
+    Py_END_ALLOW_THREADS
+    int err = ferror(f);
+    fclose(f);
+    PyMem_Free(buf);
+    if (err) {
+        PyErr_SetString(PyExc_OSError, "read error");
+        return NULL;
+    }
+    return PyLong_FromUnsignedLongLong(state_digest(&st, seed));
+}
+
+static PyObject *py_xxh64_bytes(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    unsigned long long seed = 0;
+    if (!PyArg_ParseTuple(args, "y*|K", &view, &seed))
+        return NULL;
+    xxh64_state st;
+    state_init(&st, seed);
+    state_update(&st, (const uint8_t *)view.buf, (size_t)view.len);
+    uint64_t h = state_digest(&st, seed);
+    PyBuffer_Release(&view);
+    return PyLong_FromUnsignedLongLong(h);
+}
+
+static PyMethodDef Methods[] = {
+    {"xxh64_file", py_xxh64_file, METH_VARARGS,
+     "xxh64_file(path, seed=0) -> int: XXH64 of a file's contents."},
+    {"xxh64_bytes", py_xxh64_bytes, METH_VARARGS,
+     "xxh64_bytes(data, seed=0) -> int: XXH64 of a bytes-like object."},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "Native helpers for lambdipy-tpu (XXH64 content hashing).", -1, Methods};
+
+PyMODINIT_FUNC PyInit__native(void) { return PyModule_Create(&moduledef); }

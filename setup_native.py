@@ -1,0 +1,22 @@
+"""Build the native C extension in-place:
+
+    python setup_native.py build_ext --inplace
+
+Produces ``lambdipy_tpu/_native.*.so``. The framework works without it
+(hashlib fallback in utils/fsutil.py); with it, manifest hashing of the
+multi-hundred-MB TPU payloads runs at memory bandwidth.
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    name="lambdipy-tpu-native",
+    ext_modules=[
+        Extension(
+            "lambdipy_tpu._native",
+            sources=["native/xxh64.c"],
+            extra_compile_args=["-O3"],
+        )
+    ],
+    script_args=None,
+)

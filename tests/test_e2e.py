@@ -1,0 +1,59 @@
+"""End-to-end staged configs (BASELINE.json configs 1-2) through the real
+CLI + deploy surface — the 'minimum end-to-end slice' of SURVEY.md §9.5,
+exercised exactly as a user would: build -> registry -> deploy -> invoke."""
+
+import json
+from pathlib import Path
+
+import pytest
+from click.testing import CliRunner
+
+from lambdipy_tpu.cli import main
+from lambdipy_tpu.runtime.deploy import LocalRuntime
+
+pytestmark = pytest.mark.slow
+
+CPU_ENV = {
+    "LAMBDIPY_PLATFORM": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+def _build_and_deploy(recipe, tmp_path, request_payload, deploy_name):
+    runner = CliRunner()
+    reg = str(tmp_path / "registry")
+    r = runner.invoke(main, ["build", recipe, "--registry", reg])
+    assert r.exit_code == 0, r.output
+    rt = LocalRuntime(tmp_path / "deployments.json")
+    from lambdipy_tpu.cli import _resolve_bundle
+
+    bundle = _resolve_bundle(recipe, reg)
+    dep = rt.deploy(deploy_name, bundle, env=CPU_ENV)
+    try:
+        health = rt.health(deploy_name)
+        assert health["ok"]
+        out = rt.invoke(deploy_name, request_payload)
+        assert out["ok"], out
+        return health, out
+    finally:
+        rt.stop(deploy_name)
+
+
+def test_config1_hello_numpy_bundle(tmp_path):
+    """Config 1: numpy+scipy hello-world handler (CPU baseline)."""
+    health, out = _build_and_deploy(
+        "hello-numpy", tmp_path, {"n": 32, "seed": 3}, "hello1")
+    assert isinstance(out["logdet"], float)
+    assert out["numpy"].startswith("2.")
+    # cold-start stages were reported through the readiness line
+    assert "init" in health["cold_start"]
+
+
+def test_config2_tabular_bundle_degrades_without_xgboost(tmp_path):
+    """Config 2: sklearn tabular inference; xgboost (absent offline) is
+    recorded as the degraded optional, not an error."""
+    _, out = _build_and_deploy(
+        "tabular-sklearn", tmp_path,
+        {"instances": [[0.0] * 16]}, "tab1")
+    assert out["predictions"] and out["probabilities"]
+    assert out["degraded"] == ["xgboost"]

@@ -1,0 +1,37 @@
+"""Native extension (xxh64) tests; skipped when not built."""
+
+import pytest
+
+native = pytest.importorskip("lambdipy_tpu._native")
+
+
+def test_official_vectors():
+    assert native.xxh64_bytes(b"") == 0xEF46DB3751D8E999
+    assert native.xxh64_bytes(b"a") == 0xD24EC4F1A98C6E5B
+    assert native.xxh64_bytes(b"abc") == 0x44BC2CF5AD770999
+    # seeded vector
+    assert native.xxh64_bytes(b"abc", 1) != native.xxh64_bytes(b"abc")
+
+
+def test_file_vs_bytes_consistency(tmp_path):
+    data = bytes(range(256)) * 1000 + b"tail"
+    p = tmp_path / "blob"
+    p.write_bytes(data)
+    assert native.xxh64_file(str(p)) == native.xxh64_bytes(data)
+
+
+def test_missing_file_raises(tmp_path):
+    with pytest.raises(OSError):
+        native.xxh64_file(str(tmp_path / "nope"))
+
+
+def test_hash_file_integration(tmp_path):
+    from lambdipy_tpu.utils.fsutil import hash_file
+
+    p = tmp_path / "f"
+    p.write_bytes(b"hello")
+    h = hash_file(p)
+    assert h.startswith("xxh64:")
+    assert hash_file(p, algo="sha256").startswith("sha256:")
+    # pinned algo reproduces the manifest hash exactly
+    assert hash_file(p, algo="xxh64") == h

@@ -173,3 +173,15 @@ def test_warm_populates_compile_cache_and_speeds_boot(tmp_path):
     out2 = json.loads(r2.stdout.strip().splitlines()[-1])
     assert out2["stages"]["warmup"] + out2["stages"]["init"] < \
         out1["stages"]["warmup"] + out1["stages"]["init"]
+
+
+def test_profile_endpoint_captures_trace(llama_bundle):
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    server = BundleServer(llama_bundle, port=0).start_background()
+    try:
+        out = _post(f"http://127.0.0.1:{server.port}/profile", {"invokes": 1})
+        assert out["ok"]
+        assert Path(out["dir"]).is_dir()
+    finally:
+        server.stop()
