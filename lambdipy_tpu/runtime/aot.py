@@ -1,0 +1,197 @@
+"""AOT executable store: ship compiled programs inside the bundle.
+
+Cold start on TPU is interpreter + PJRT init + trace/lower/compile
+(BASELINE.md: ~10 s floor; SURVEY.md §9.6 names AOT as the make-or-break
+weapon). The persistent compile cache (loader.attach_compile_cache) already
+turns XLA *compilation* into a disk hit, but tracing + lowering a real
+model is still seconds of Python. This module removes that too, with two
+tiers stored under ``<bundle>/aot/``:
+
+- **tier 2 — serialized executable** (``*.exec``): the PJRT-compiled
+  program via ``jax.experimental.serialize_executable``. Zero trace, zero
+  lower, zero compile at boot. Only valid for the exact (platform, jax,
+  jaxlib) that produced it — the key encodes all three, and loading is
+  best-effort (some PJRT plugins don't support executable serialization).
+- **tier 1 — jax.export StableHLO** (``*.hlo``): portable serialized
+  module. Boot skips tracing/lowering; the compile that remains is a
+  persistent-cache hit because the builder warmed it.
+
+Misses fall through to plain ``jax.jit`` and (best-effort) write both
+artifacts so the *next* boot — or the built bundle, when the builder's
+warm subprocess does this — is fast. The reference has no analog: its
+"AOT" is shipping pre-built wheels (SURVEY.md §1); this is the same idea
+one level down, at the XLA-program level.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from pathlib import Path
+from typing import Any, Callable, Sequence
+
+from lambdipy_tpu.utils.fsutil import atomic_write_bytes, atomic_write_text
+from lambdipy_tpu.utils.logs import get_logger
+
+log = get_logger("lambdipy.aot")
+
+_SCHEMA = 1
+
+
+def _env_key() -> dict:
+    import jax
+    import jaxlib
+
+    return {
+        "schema": _SCHEMA,
+        "platform": jax.default_backend(),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "n_devices": len(jax.devices()),
+    }
+
+
+class AotStore:
+    """Directory of AOT artifacts for one bundle, keyed by entry name and
+    the producing environment."""
+
+    def __init__(self, bundle_dir: Path):
+        self.dir = Path(bundle_dir) / "aot"
+
+    def _paths(self, name: str) -> dict[str, Path]:
+        import jax
+
+        stem = f"{name}.{jax.default_backend()}"
+        return {
+            "meta": self.dir / f"{stem}.json",
+            "hlo": self.dir / f"{stem}.hlo",
+            "exec": self.dir / f"{stem}.exec",
+        }
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, name: str, fn: Callable,
+             example_args: Sequence[Any]) -> tuple[dict, Callable]:
+        """Export ``fn`` at ``example_args``'s shapes; write tier 1 always,
+        tier 2 when the backend supports executable serialization.
+
+        Returns ``(meta, jitted)`` — the same ``jax.jit`` object the export
+        used, so a miss path can serve from it instead of re-tracing.
+        Artifact writes are atomic and the meta (which declares the tiers)
+        lands last: a crash mid-save leaves no meta, never a meta pointing
+        at a torn tier file.
+        """
+        import jax
+
+        self.dir.mkdir(parents=True, exist_ok=True)
+        paths = self._paths(name)
+        meta = _env_key()
+        meta["tiers"] = []
+
+        jitted = jax.jit(fn)
+        try:
+            exported = jax.export.export(jitted)(*example_args)
+            atomic_write_bytes(paths["hlo"], bytes(exported.serialize()))
+            meta["tiers"].append("hlo")
+        except Exception as e:
+            log.warning("aot %s: jax.export failed: %s", name, e)
+
+        try:
+            from jax.experimental import serialize_executable
+
+            compiled = jitted.lower(*example_args).compile()
+            payload = serialize_executable.serialize(compiled)
+            atomic_write_bytes(paths["exec"], pickle.dumps(payload))
+            meta["tiers"].append("exec")
+        except Exception as e:
+            log.info("aot %s: executable serialization unavailable: %s", name, e)
+
+        if meta["tiers"]:
+            atomic_write_text(paths["meta"], json.dumps(meta, indent=1))
+        return meta, jitted
+
+    # -- load ---------------------------------------------------------------
+
+    def load(self, name: str,
+             example_args: Sequence[Any] | None = None) -> tuple[Callable, str] | None:
+        """Return ``(callable, tier)`` for the best available artifact
+        matching the current environment, or None.
+
+        When ``example_args`` is given each candidate tier is probe-invoked
+        before being returned — an AOT executable can deserialize fine yet
+        fail at call time (observed: XLA:CPU AOT rejects a host whose CPU
+        features differ from the compile machine). The probe doubles as the
+        warmup invoke, so it costs the boot path nothing.
+        """
+        paths = self._paths(name)
+        if not paths["meta"].is_file():
+            return None
+        try:
+            meta = json.loads(paths["meta"].read_text())
+        except Exception:
+            return None
+        env = _env_key()
+        if any(meta.get(k) != env[k]
+               for k in ("schema", "platform", "jax", "jaxlib", "n_devices")):
+            log.info("aot %s: environment mismatch (%s vs %s), ignoring",
+                     name, meta, env)
+            return None
+
+        def _probe(fn: Callable) -> bool:
+            if example_args is None:
+                return True
+            import jax
+
+            jax.block_until_ready(fn(*example_args))
+            return True
+
+        if "exec" in meta.get("tiers", ()) and paths["exec"].is_file():
+            try:
+                from jax.experimental import serialize_executable
+
+                payload = pickle.loads(paths["exec"].read_bytes())
+                compiled = serialize_executable.deserialize_and_load(*payload)
+                _probe(compiled)
+                return compiled, "exec"
+            except Exception as e:
+                log.warning("aot %s: exec tier failed to load: %s", name, e)
+
+        if "hlo" in meta.get("tiers", ()) and paths["hlo"].is_file():
+            try:
+                import jax
+
+                exported = jax.export.deserialize(
+                    bytearray(paths["hlo"].read_bytes()))
+                fn = jax.jit(exported.call)
+                _probe(fn)
+                return fn, "hlo"
+            except Exception as e:
+                log.warning("aot %s: hlo tier failed to load: %s", name, e)
+        return None
+
+
+def cached_jit(ctx, name: str, fn: Callable,
+               example_args: Sequence[Any]) -> tuple[Callable, str]:
+    """The handler-facing entry: AOT artifact if present, else ``jax.jit``
+    plus a best-effort save so the next boot skips trace/lower/compile.
+
+    ``ctx`` is a HandlerContext (anything with ``bundle_dir``). Artifacts
+    are keyed by device count (load rejects a topology mismatch); callers
+    should only use this on the single-chip path — meshes re-shard at load
+    in _maybe_shard. The returned callable is shape-specialized to
+    ``example_args`` on a hit; handlers keep a plain-jit fallback for
+    other shapes. Returns ``(callable, source)``, source in
+    {"exec", "hlo", "jit"}.
+    """
+    import jax
+
+    store = AotStore(ctx.bundle_dir)
+    hit = store.load(name, example_args)
+    if hit is not None:
+        return hit
+    try:
+        _, jitted = store.save(name, fn, example_args)
+        return jitted, "jit"
+    except Exception as e:  # bundle dir read-only, export unsupported, ...
+        log.info("aot %s: save skipped: %s", name, e)
+    return jax.jit(fn), "jit"

@@ -101,6 +101,35 @@ def _jax_adapter_and_params(spec: dict, ctx):
     return adapter, params
 
 
+def _aot_or_jit(ctx, fn, example_args, mesh):
+    """Single-chip path boots from the bundle's AOT store (runtime/aot.py);
+    meshed programs depend on the live device set, so they always re-jit.
+
+    AOT artifacts are shape-specialized to the spec's example batch, so a
+    hit is wrapped with a shape dispatch: example-shaped requests (the hot
+    serving path) run the AOT program, anything else re-traces through a
+    plain jit fallback exactly as before AOT existed.
+    """
+    import jax
+
+    if mesh is not None:
+        return jax.jit(fn), "jit"
+    from lambdipy_tpu.runtime.aot import cached_jit
+
+    cached, src = cached_jit(ctx, "forward", fn, example_args)
+    if src == "jit":
+        return cached, src
+    fallback = jax.jit(fn)
+    shapes = tuple(getattr(a, "shape", None) for a in example_args[1:])
+
+    def dispatch(params, *args):
+        if tuple(getattr(a, "shape", None) for a in args) == shapes:
+            return cached(params, *args)
+        return fallback(params, *args)
+
+    return dispatch, src
+
+
 def _maybe_shard(adapter, params, spec: dict):
     """Place params on the payload mesh when it needs more than one device;
     single-chip serving skips mesh machinery entirely."""
@@ -131,7 +160,7 @@ def image_classify_handler(spec: dict, ctx) -> HandlerState:
     params, mesh = _maybe_shard(adapter, params, spec)
     batch = int(spec.get("batch_size", 1))
     example = adapter.example_batch(batch)[0]
-    fwd = jax.jit(adapter.forward)
+    fwd, aot_src = _aot_or_jit(ctx, adapter.forward, (params, example), mesh)
 
     def run(x):
         if mesh is not None:
@@ -158,7 +187,7 @@ def image_classify_handler(spec: dict, ctx) -> HandlerState:
 
     return HandlerState(invoke_fn=invoke, meta={
         "model": spec["model"], "batch": batch,
-        "sharded": mesh is not None,
+        "sharded": mesh is not None, "aot": aot_src,
         "platform": jax.devices()[0].platform,
     })
 
@@ -172,8 +201,9 @@ def text_classify_handler(spec: dict, ctx) -> HandlerState:
     adapter, params = _jax_adapter_and_params(spec, ctx)
     params, mesh = _maybe_shard(adapter, params, spec)
     cfg = adapter.config
-    fwd = jax.jit(adapter.forward)
     example_ids, example_mask = adapter.example_batch(int(spec.get("batch_size", 1)))
+    fwd, aot_src = _aot_or_jit(
+        ctx, adapter.forward, (params, example_ids, example_mask), mesh)
 
     def run(ids, mask):
         if mesh is not None:
@@ -203,7 +233,7 @@ def text_classify_handler(spec: dict, ctx) -> HandlerState:
 
     return HandlerState(invoke_fn=invoke, meta={
         "model": spec["model"], "max_len": cfg.max_len,
-        "sharded": mesh is not None,
+        "sharded": mesh is not None, "aot": aot_src,
     })
 
 

@@ -1,0 +1,112 @@
+"""AOT store: serialized executables / StableHLO shipped in the bundle
+(runtime/aot.py). The contract under test: miss -> plain jit + artifacts
+written; hit -> identical numerics without re-tracing; any corruption or
+environment mismatch -> silent fallback to jit."""
+
+import json
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models import registry
+from lambdipy_tpu.runtime.aot import AotStore, cached_jit
+
+
+@pytest.fixture()
+def tiny_model():
+    adapter = registry.get("resnet50-tiny").build(dtype="float32")
+    params = adapter.init_params(seed=0, batch_size=1)
+    x = adapter.example_batch(1)[0]
+    return adapter, params, x
+
+
+def _ctx(tmp_path):
+    return SimpleNamespace(bundle_dir=tmp_path)
+
+
+def test_miss_jits_and_writes_artifacts(tmp_path, tiny_model):
+    adapter, params, x = tiny_model
+    fn, src = cached_jit(_ctx(tmp_path), "forward", adapter.forward, (params, x))
+    assert src == "jit"
+    out = np.asarray(fn(params, x))
+    aot_dir = tmp_path / "aot"
+    metas = list(aot_dir.glob("forward.*.json"))
+    assert metas, "miss should write AOT artifacts for the next boot"
+    meta = json.loads(metas[0].read_text())
+    assert "hlo" in meta["tiers"]
+    assert np.all(np.isfinite(out))
+
+
+def test_hit_matches_jit_numerics(tmp_path, tiny_model):
+    adapter, params, x = tiny_model
+    ctx = _ctx(tmp_path)
+    fn0, src0 = cached_jit(ctx, "forward", adapter.forward, (params, x))
+    expected = np.asarray(fn0(params, x))
+
+    fn1, src1 = cached_jit(ctx, "forward", adapter.forward, (params, x))
+    assert src1 in ("exec", "hlo"), f"second boot should hit AOT, got {src1}"
+    got = np.asarray(fn1(params, x))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_env_mismatch_falls_back_to_jit(tmp_path, tiny_model):
+    adapter, params, x = tiny_model
+    ctx = _ctx(tmp_path)
+    cached_jit(ctx, "forward", adapter.forward, (params, x))
+    meta_path = next((tmp_path / "aot").glob("forward.*.json"))
+    meta = json.loads(meta_path.read_text())
+    meta["jaxlib"] = "0.0.0-other"
+    meta_path.write_text(json.dumps(meta))
+
+    store = AotStore(tmp_path)
+    assert store.load("forward") is None
+
+
+def test_corrupt_artifact_falls_back(tmp_path, tiny_model):
+    adapter, params, x = tiny_model
+    ctx = _ctx(tmp_path)
+    cached_jit(ctx, "forward", adapter.forward, (params, x))
+    for f in (tmp_path / "aot").glob("forward.*"):
+        if f.suffix in (".hlo", ".exec"):
+            f.write_bytes(b"garbage")
+    fn, src = cached_jit(ctx, "forward", adapter.forward, (params, x))
+    assert src == "jit"
+    assert np.all(np.isfinite(np.asarray(fn(params, x))))
+
+
+def test_aot_hit_still_serves_other_batch_sizes(tmp_path):
+    """An AOT artifact is shape-specialized to the spec's example batch;
+    requests with a different batch must still work (plain-jit fallback in
+    handlers._aot_or_jit), not 500."""
+    from lambdipy_tpu.runtime import handlers
+
+    spec = {"model": "resnet50-tiny", "dtype": "float32", "batch_size": 1}
+    ctx = SimpleNamespace(bundle_dir=tmp_path, manifest={}, params_dir=None,
+                          spec=spec)
+    handlers.image_classify_handler(spec, ctx)  # miss: writes artifacts
+    h = handlers.image_classify_handler(spec, ctx)
+    assert h.meta["aot"] in ("exec", "hlo")
+
+    adapter = registry.get("resnet50-tiny").build(dtype="float32")
+    batch2 = np.asarray(adapter.example_batch(2)[0], dtype=np.float32)
+    out = h.invoke({"image": batch2.tolist()})
+    assert out["ok"] and len(out["top1"]) == 2
+    out1 = h.invoke({"random": True})
+    assert out1["ok"] and len(out1["top1"]) == 1
+
+
+def test_different_dtype_entry_points_coexist(tmp_path):
+    adapter = registry.get("resnet50-tiny").build(dtype="bfloat16")
+    params = adapter.init_params(seed=0, batch_size=1)
+    x = adapter.example_batch(1)[0]
+    ctx = _ctx(tmp_path)
+    store = AotStore(tmp_path)
+    store.save("fwd_bf16", adapter.forward, (params, x))
+    hit = store.load("fwd_bf16", (params, x))
+    assert hit is not None
+    fn, tier = hit
+    out = np.asarray(fn(params, x), dtype=np.float32)
+    assert out.dtype == np.float32 and np.all(np.isfinite(out))
+    assert jnp.asarray(x).dtype == jnp.bfloat16
