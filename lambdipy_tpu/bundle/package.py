@@ -77,7 +77,8 @@ def materialize_payload(recipe: Recipe, bundle_dir: Path) -> dict:
 
         params_dir = Path(bundle_dir) / "params"
         info = model_registry.save_init_params(
-            payload.model, params_dir, dtype=payload.dtype, quant=payload.quant)
+            payload.model, params_dir, dtype=payload.dtype, quant=payload.quant,
+            extra=dict(payload.extra))
         manifest_payload["params"] = "params"
         manifest_payload["params_info"] = info
     return manifest_payload

@@ -24,6 +24,9 @@ log = get_logger("lambdipy.cli")
 @click.group()
 def main():
     """lambdipy-tpu: TPU-native serverless bundle framework."""
+    from lambdipy_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
 
 
 # -- recipe/registry admin --------------------------------------------------
@@ -193,6 +196,12 @@ def _resolve_bundle(name_or_dir: str, registry_dir) -> Path:
             f"recipe {name_or_dir!r} has no built artifact; run: lambdipy build {name_or_dir}")
     if registry.has(name_or_dir):
         return registry.fetch(name_or_dir)
+    # custom recipes (built with --recipe-dir) aren't in the builtin store;
+    # resolve them by the recipe name recorded at publish time
+    by_recipe = [a for a in registry.list() if a.recipe == name_or_dir]
+    if by_recipe:
+        latest = max(by_recipe, key=lambda a: a.created)
+        return registry.fetch(latest.artifact_id)
     raise click.ClickException(f"{name_or_dir!r} is neither a bundle dir, recipe, nor artifact id")
 
 

@@ -90,8 +90,21 @@ class LocalRuntime:
         repo_root = str(Path(__file__).resolve().parents[2])
         full_env["PYTHONPATH"] = os.pathsep.join(
             [repo_root] + [p for p in full_env.get("PYTHONPATH", "").split(os.pathsep) if p])
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        # server stderr goes to a per-deployment log so a boot failure is
+        # diagnosable (`serve.log` beside the state file)
+        log_path = self.state_path.parent / f"{name}.serve.log"
+        log_path.parent.mkdir(parents=True, exist_ok=True)
+        stderr_f = open(log_path, "w")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=stderr_f,
                                 text=True, env=full_env, start_new_session=True)
+        stderr_f.close()
+
+        def _log_tail() -> str:
+            try:
+                return log_path.read_text(errors="replace")[-800:]
+            except OSError:
+                return ""
+
         deadline = time.monotonic() + ready_timeout
         ready_line = None
         while time.monotonic() < deadline:
@@ -99,7 +112,8 @@ class LocalRuntime:
             if not line:
                 if proc.poll() is not None:
                     raise DeployError(
-                        f"server for {name!r} exited rc={proc.returncode} before ready")
+                        f"server for {name!r} exited rc={proc.returncode} before "
+                        f"ready; log tail ({log_path}):\n{_log_tail()}")
                 time.sleep(0.05)
                 continue
             try:
@@ -111,7 +125,9 @@ class LocalRuntime:
                 break
         if ready_line is None:
             proc.kill()
-            raise DeployError(f"deployment {name!r} not ready within {ready_timeout}s")
+            raise DeployError(
+                f"deployment {name!r} not ready within {ready_timeout}s; "
+                f"log tail ({log_path}):\n{_log_tail()}")
         dep = Deployment(name=name, bundle_dir=str(bundle_dir), pid=proc.pid,
                          port=ready_line["port"],
                          cold_start=ready_line.get("cold_start", {}))
@@ -159,6 +175,12 @@ class LocalRuntime:
         state = self._load()
         state.pop(name, None)
         self._save(state)
+        # the per-deployment serve.log dies with its deployment entry —
+        # otherwise one orphan file per deployment name accumulates forever
+        try:
+            (self.state_path.parent / f"{name}.serve.log").unlink(missing_ok=True)
+        except OSError:
+            pass
         log_event(log, "stopped", name=name)
 
 

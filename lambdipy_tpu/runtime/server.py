@@ -171,18 +171,9 @@ def main(argv=None) -> int:
     if not argv:
         print("usage: server <bundle_dir> [port]", file=sys.stderr)
         return 2
-    # Platform override via our own env var: JAX_PLATFORMS=cpu at interpreter
-    # start hangs this image's axon sitecustomize (see tests/conftest.py), so
-    # the deploy controller passes LAMBDIPY_PLATFORM and we switch after
-    # startup, before the backend initializes.
-    platform = os.environ.get("LAMBDIPY_PLATFORM")
-    if platform:
-        try:
-            import jax
+    from lambdipy_tpu.utils.platform import apply_platform_override
 
-            jax.config.update("jax_platforms", platform)
-        except Exception as e:
-            log.warning("platform override %r failed: %s", platform, e)
+    apply_platform_override()
     bundle = Path(argv[0])
     port = int(argv[1]) if len(argv) > 1 else 0
     server = BundleServer(bundle, port=port)

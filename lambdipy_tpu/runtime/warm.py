@@ -42,14 +42,9 @@ def main(argv=None) -> int:
     if not argv:
         print("usage: warm <bundle_dir>", file=sys.stderr)
         return 2
-    platform = os.environ.get("LAMBDIPY_PLATFORM")
-    if platform:
-        try:
-            import jax
+    from lambdipy_tpu.utils.platform import apply_platform_override
 
-            jax.config.update("jax_platforms", platform)
-        except Exception:
-            pass
+    apply_platform_override()
     print(json.dumps(warm_bundle(Path(argv[0]))), flush=True)
     return 0
 

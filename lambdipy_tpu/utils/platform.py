@@ -1,0 +1,33 @@
+"""The one place LAMBDIPY_PLATFORM is honored.
+
+JAX_PLATFORMS=cpu at interpreter start hangs this image's axon
+sitecustomize (measured; see tests/conftest.py), so every entry point —
+CLI, serve runtime, warm subprocess — switches the platform *after*
+startup via jax.config, before any backend initializes. All three call
+this helper so the behavior (and the warning on failure) stays uniform.
+"""
+
+from __future__ import annotations
+
+import os
+
+from lambdipy_tpu.utils.logs import get_logger
+
+log = get_logger("lambdipy.platform")
+
+
+def apply_platform_override() -> str | None:
+    """Switch jax to the platform named by LAMBDIPY_PLATFORM, if set.
+    Returns the platform applied, or None. Failure is a warning, not an
+    error: the process continues on whatever platform jax picked."""
+    platform = os.environ.get("LAMBDIPY_PLATFORM")
+    if not platform:
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        return platform
+    except Exception as e:
+        log.warning("platform override %r failed: %s", platform, e)
+        return None

@@ -19,16 +19,20 @@ CPU_ENV = {
 }
 
 
-def _build_and_deploy(recipe, tmp_path, request_payload, deploy_name):
+def _build_and_deploy(recipe, tmp_path, request_payload, deploy_name,
+                      recipe_dir=None, env=None):
     runner = CliRunner()
     reg = str(tmp_path / "registry")
-    r = runner.invoke(main, ["build", recipe, "--registry", reg])
+    args = ["build", recipe, "--registry", reg]
+    if recipe_dir is not None:
+        args += ["--recipe-dir", str(recipe_dir)]
+    r = runner.invoke(main, args)
     assert r.exit_code == 0, r.output
     rt = LocalRuntime(tmp_path / "deployments.json")
     from lambdipy_tpu.cli import _resolve_bundle
 
     bundle = _resolve_bundle(recipe, reg)
-    dep = rt.deploy(deploy_name, bundle, env=CPU_ENV)
+    dep = rt.deploy(deploy_name, bundle, env=env or CPU_ENV)
     try:
         health = rt.health(deploy_name)
         assert health["ok"]
@@ -37,6 +41,14 @@ def _build_and_deploy(recipe, tmp_path, request_payload, deploy_name):
         return health, out
     finally:
         rt.stop(deploy_name)
+
+
+def _write_recipe(tmp_path, text):
+    d = tmp_path / "recipes"
+    d.mkdir(exist_ok=True)
+    name = text.split('name = "', 1)[1].split('"', 1)[0]
+    (d / f"{name}.toml").write_text(text)
+    return d
 
 
 def test_config1_hello_numpy_bundle(tmp_path):
@@ -57,3 +69,105 @@ def test_config2_tabular_bundle_degrades_without_xgboost(tmp_path):
         {"instances": [[0.0] * 16]}, "tab1")
     assert out["predictions"] and out["probabilities"]
     assert out["degraded"] == ["xgboost"]
+
+
+def test_config3_resnet_serving_bundle(tmp_path):
+    """Config 3 shape (north star): flax ResNet image-classify bundle through
+    build -> deploy -> /invoke, tiny dims so CPU CI stays fast. The real
+    jax-resnet50 recipe differs only in model size and device pin."""
+    recipe_dir = _write_recipe(tmp_path, '''
+schema = 1
+name = "e2e-resnet"
+version = "0.1"
+device = "any"
+base_layer = "jax-tpu"
+requires = []
+
+[payload]
+model = "resnet50-tiny"
+handler = "lambdipy_tpu.runtime.handlers:image_classify_handler"
+params = "init"
+dtype = "float32"
+batch_size = 1
+''')
+    health, out = _build_and_deploy(
+        "e2e-resnet", tmp_path, {"random": True}, "rn1", recipe_dir=recipe_dir)
+    assert len(out["top5"][0]) == 5
+    assert health["handler_meta"]["model"] == "resnet50-tiny"
+    assert health["handler_meta"]["aot"] in ("exec", "hlo", "jit")
+
+
+def test_config4_torch_bert_degrades_to_cpu(tmp_path):
+    """Config 4: torch BERT text-classify; torch-xla is absent offline so the
+    handler serves on CPU torch and reports the degradation (SURVEY.md §9.7).
+    Tiny dims exercise the payload.extra -> save_init_params path."""
+    recipe_dir = _write_recipe(tmp_path, '''
+schema = 1
+name = "e2e-torch-bert"
+version = "0.1"
+device = "any"
+base_layer = "torch"
+requires = []
+optional_requires = ["torch-xla"]
+
+[payload]
+model = "bert-base-torch"
+handler = "lambdipy_tpu.runtime.handlers:torch_text_classify_handler"
+params = "init"
+dtype = "float32"
+batch_size = 1
+
+[payload.extra]
+vocab_size = 128
+hidden = 32
+layers = 1
+heads = 2
+max_len = 16
+num_classes = 2
+''')
+    health, out = _build_and_deploy(
+        "e2e-torch-bert", tmp_path, {"input_ids": [5, 9, 2]}, "tb1",
+        recipe_dir=recipe_dir)
+    assert out["labels"][0] in (0, 1)
+    assert out["device"] == "cpu"  # documented degraded path, not an error
+    assert health["handler_meta"]["device"] == "cpu"
+
+
+def test_config5_llama_int8_tp_generate(tmp_path):
+    """Config 5 shape: int8 tensor-parallel Llama generate over a 2-device
+    mesh (virtual CPU devices; same code path as tp=4 on v5e-4)."""
+    recipe_dir = _write_recipe(tmp_path, '''
+schema = 1
+name = "e2e-llama-tp"
+version = "0.1"
+device = "any"
+base_layer = "jax-tpu"
+requires = []
+
+[payload]
+model = "llama-tiny"
+handler = "lambdipy_tpu.runtime.handlers:generate_handler"
+params = "init"
+dtype = "float32"
+quant = "int8"
+batch_size = 1
+
+[payload.mesh]
+dp = 1
+tp = 2
+
+[payload.extra]
+max_new_tokens = 4
+''')
+    env = {
+        "LAMBDIPY_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    health, out = _build_and_deploy(
+        "e2e-llama-tp", tmp_path,
+        {"tokens": [1, 2, 3], "max_new_tokens": 4}, "ll1",
+        recipe_dir=recipe_dir, env=env)
+    assert out["n_new"] >= 4 and out["tokens"]
+    meta = health["handler_meta"]
+    assert meta["sharded"] is True, f"expected tp=2 mesh to shard: {meta}"
+    assert meta["quant"] == "int8"
