@@ -72,36 +72,35 @@ def artifacts_cmd(registry_dir):
 # -- build / package --------------------------------------------------------
 
 
-@main.command("build")
-@click.argument("recipe_name")
-@click.option("--out", type=click.Path(), default=None,
-              help="bundle output dir (default: temp + registry publish)")
-@click.option("--registry", "registry_dir", type=click.Path(), default=None)
-@click.option("--recipe-dir", type=click.Path(), default=None)
-@click.option("--no-smoke", is_flag=True, help="skip the hermetic import smoke")
-@click.option("--no-payload", is_flag=True, help="skip params/handler materialization")
-@click.option("--force", is_flag=True, help="rebuild even if the artifact is cached")
-@click.option("--warm/--no-warm", default=True,
-              help="pre-populate the bundle's XLA compile cache (model recipes)")
-def build_cmd(recipe_name, out, registry_dir, recipe_dir, no_smoke, no_payload,
-              force, warm):
-    """Build a recipe into a bundle and publish it to the local registry
-    (cache-hit short-circuits like the reference's prebuilt fetch)."""
+def _pyver() -> str:
+    return f"{sys.version_info.major}.{sys.version_info.minor}"
+
+
+def _registry_lookup(registry, recipe, pyver: str) -> str | None:
+    """Artifact id under which this recipe is cached locally, or None.
+
+    Checks the locally computed id first, then any artifact recorded for
+    the same recipe+version (a prebuilt fetched for ``device=any`` is
+    published under the *asset's* artifact id, which can differ from the
+    id a device-pinned recipe computes)."""
+    exact = recipe.artifact_id(pyver)
+    if registry.has(exact):
+        return exact
+    matches = [a for a in registry.list()
+               if a.recipe == recipe.name and a.version == recipe.version]
+    if matches:
+        return max(matches, key=lambda a: a.created).artifact_id
+    return None
+
+
+def _run_build(recipe, registry, *, out=None, no_smoke=False, no_payload=False,
+               warm=True):
+    """Build one recipe into a bundle and publish it to the local registry.
+    Shared by ``build`` (user path) and ``publish`` (maintainer path)."""
     from lambdipy_tpu.buildengine import build_recipe
     from lambdipy_tpu.bundle import assemble_bundle
-    from lambdipy_tpu.recipes import builtin_store
-    from lambdipy_tpu.resolve.registry import ArtifactRegistry
 
-    store = builtin_store(recipe_dir)
-    recipe = store.get(recipe_name)
-    registry = ArtifactRegistry(registry_dir)
-    pyver = f"{sys.version_info.major}.{sys.version_info.minor}"
-    artifact_id = recipe.artifact_id(pyver)
-
-    if not force and out is None and registry.has(artifact_id):
-        click.echo(f"cache hit: {artifact_id} (use --force to rebuild)")
-        return
-
+    artifact_id = recipe.artifact_id(_pyver())
     workdir = Path(tempfile.mkdtemp(prefix=f"lambdipy-build-{recipe.name}-"))
     result = build_recipe(recipe, workdir, run_smoke=not no_smoke)
     bundle_dir = Path(out) if out else workdir / "bundle"
@@ -137,6 +136,173 @@ def build_cmd(recipe_name, out, registry_dir, recipe_dir, no_smoke, no_payload,
     p = result.prune
     click.echo(f"size {p.bytes_after / 1e6:.1f}MB (saved {p.bytes_saved / 1e6:.1f}MB); "
                f"skipped optional: {result.skipped_optional or 'none'}")
+    return artifact_id
+
+
+@main.command("build")
+@click.argument("recipe_name")
+@click.option("--out", type=click.Path(), default=None,
+              help="bundle output dir (default: temp + registry publish)")
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+@click.option("--recipe-dir", type=click.Path(), default=None)
+@click.option("--release-store", "release_store", type=click.Path(), default=None,
+              help="prebuilt-release store to consult before building "
+                   "(default: $LAMBDIPY_RELEASE_STORE)")
+@click.option("--no-prebuilt", is_flag=True,
+              help="skip the prebuilt-release lookup and always build locally")
+@click.option("--no-smoke", is_flag=True, help="skip the hermetic import smoke")
+@click.option("--no-payload", is_flag=True, help="skip params/handler materialization")
+@click.option("--force", is_flag=True, help="rebuild even if the artifact is cached")
+@click.option("--warm/--no-warm", default=True,
+              help="pre-populate the bundle's XLA compile cache (model recipes)")
+def build_cmd(recipe_name, out, registry_dir, recipe_dir, release_store,
+              no_prebuilt, no_smoke, no_payload, force, warm):
+    """Build a recipe into a bundle: local-registry cache hit, then prebuilt
+    release fetch, then local build — the reference's hot path (SURVEY.md
+    §4 A: release-index hit downloads, miss falls back to the build path)."""
+    from lambdipy_tpu.recipes import builtin_store
+    from lambdipy_tpu.resolve.registry import ArtifactRegistry
+    from lambdipy_tpu.resolve.releases import ReleaseFetcher, default_store
+
+    from lambdipy_tpu.resolve.releases import ReleaseError
+
+    store = builtin_store(recipe_dir)
+    recipe = store.get(recipe_name)
+    registry = ArtifactRegistry(registry_dir)
+
+    if not force and out is None:
+        cached = _registry_lookup(registry, recipe, _pyver())
+        if cached is not None:
+            click.echo(f"cache hit: {cached} (use --force to rebuild)")
+            return
+
+    if not force and out is None and not no_prebuilt:
+        releases = default_store(release_store)
+        if releases is not None:
+            asset = releases.find_asset(recipe=recipe.name, python=_pyver(),
+                                        device=recipe.device,
+                                        version=recipe.version)
+            if asset is not None:
+                try:
+                    ReleaseFetcher(releases).fetch_into_registry(asset, registry)
+                except ReleaseError as e:
+                    click.echo(f"warning: prebuilt fetch failed ({e}); "
+                               "building locally", err=True)
+                else:
+                    click.echo(f"fetched prebuilt {asset.name} "
+                               f"(release {asset.tag}) -> {asset.artifact_id}")
+                    return
+
+    _run_build(recipe, registry, out=out, no_smoke=no_smoke,
+               no_payload=no_payload, warm=warm)
+
+
+# -- prebuilt releases (maintainer publish / user fetch) ---------------------
+
+
+def _require_store(release_store):
+    from lambdipy_tpu.resolve.releases import STORE_ENV, default_store
+
+    store = default_store(release_store)
+    if store is None:
+        raise click.ClickException(
+            f"no release store: pass --release-store or set {STORE_ENV}")
+    return store
+
+
+@main.command("publish")
+@click.argument("recipe_names", nargs=-1)
+@click.option("--all", "publish_all", is_flag=True,
+              help="publish every builtin recipe")
+@click.option("--release-store", "release_store", type=click.Path(), default=None)
+@click.option("--tag", default=None,
+              help="release tag (default: lambdipy-tpu version)")
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+@click.option("--recipe-dir", type=click.Path(), default=None)
+@click.option("--rebuild", is_flag=True, help="rebuild even if cached locally")
+@click.option("--warm/--no-warm", default=True)
+def publish_cmd(recipe_names, publish_all, release_store, tag, registry_dir,
+                recipe_dir, rebuild, warm):
+    """Maintainer path: build recipes and upload the bundles as prebuilt
+    release assets (SURVEY.md §4 C: build each recipe x python version,
+    create/append release, upload asset). Users then ``lambdipy fetch`` /
+    ``lambdipy build`` without compiling anything."""
+    import tempfile as _tempfile
+
+    from lambdipy_tpu import __version__
+    from lambdipy_tpu.recipes import builtin_store
+    from lambdipy_tpu.resolve.registry import ArtifactRegistry
+    from lambdipy_tpu.resolve.releases import ReleaseError, pack_bundle
+
+    if not recipe_names and not publish_all:
+        raise click.ClickException("pass recipe names or --all")
+    store = builtin_store(recipe_dir)
+    names = list(store.names()) if publish_all else list(recipe_names)
+    releases = _require_store(release_store)
+    registry = ArtifactRegistry(registry_dir)
+    tag = tag or f"v{__version__}"
+    for name in names:
+        recipe = store.get(name)
+        if _pyver() not in recipe.python:
+            click.echo(f"skip {name}: recipe pins python {recipe.python}")
+            continue
+        artifact_id = recipe.artifact_id(_pyver())
+        if rebuild or not registry.has(artifact_id):
+            _run_build(recipe, registry, warm=warm)
+        bundle = registry.fetch(artifact_id)
+        with _tempfile.TemporaryDirectory(prefix="lambdipy-publish-") as td:
+            archive = pack_bundle(bundle, Path(td) / f"{artifact_id}.tar.gz")
+            try:
+                asset = releases.upload_asset(
+                    tag, archive, artifact_id=artifact_id, recipe=recipe.name,
+                    version=recipe.version, python=_pyver(), device=recipe.device)
+            except ReleaseError as e:
+                raise click.ClickException(str(e)) from e
+        click.echo(f"published {asset.name} ({asset.size / 1e6:.1f}MB) "
+                   f"-> release {tag}")
+
+
+@main.command("fetch")
+@click.argument("recipe_name")
+@click.option("--release-store", "release_store", type=click.Path(), default=None)
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+@click.option("--recipe-dir", type=click.Path(), default=None)
+def fetch_cmd(recipe_name, release_store, registry_dir, recipe_dir):
+    """User path: download a prebuilt bundle from the release store into the
+    local registry (hash-verified, cached) — the reference's 'download
+    matching release asset' branch without any local build."""
+    from lambdipy_tpu.recipes import builtin_store
+    from lambdipy_tpu.resolve.registry import ArtifactRegistry
+    from lambdipy_tpu.resolve.releases import ReleaseError, ReleaseFetcher
+
+    releases = _require_store(release_store)
+    store = builtin_store(recipe_dir)
+    device = version = None
+    if recipe_name in store:
+        recipe = store.get(recipe_name)
+        device, version = recipe.device, recipe.version
+    asset = releases.find_asset(recipe=recipe_name, python=_pyver(),
+                                device=device, version=version)
+    if asset is None:
+        raise click.ClickException(
+            f"no prebuilt asset for {recipe_name!r} (python {_pyver()}) in "
+            f"{releases.root}")
+    try:
+        ReleaseFetcher(releases).fetch_into_registry(
+            asset, ArtifactRegistry(registry_dir))
+    except ReleaseError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"fetched {asset.name} (release {asset.tag}) -> {asset.artifact_id}")
+
+
+@main.command("releases")
+@click.option("--release-store", "release_store", type=click.Path(), default=None)
+def releases_cmd(release_store):
+    """List prebuilt assets in the release store."""
+    releases = _require_store(release_store)
+    for asset in releases.list_assets():
+        click.echo(f"{asset.tag:12s} {asset.name:55s} {asset.size / 1e6:8.1f}MB "
+                   f"py{asset.python} {asset.device}")
 
 
 @main.command("package")
@@ -188,9 +354,8 @@ def _resolve_bundle(name_or_dir: str, registry_dir) -> Path:
     registry = ArtifactRegistry(registry_dir)
     store = builtin_store()
     if name_or_dir in store:
-        pyver = f"{sys.version_info.major}.{sys.version_info.minor}"
-        artifact_id = store.get(name_or_dir).artifact_id(pyver)
-        if registry.has(artifact_id):
+        artifact_id = _registry_lookup(registry, store.get(name_or_dir), _pyver())
+        if artifact_id is not None:
             return registry.fetch(artifact_id)
         raise click.ClickException(
             f"recipe {name_or_dir!r} has no built artifact; run: lambdipy build {name_or_dir}")

@@ -329,7 +329,7 @@ def save_init_params(model: str, params_dir: Path, *, dtype: str = "bfloat16",
         # checkpoint host arrays, not device arrays: orbax records the
         # save-time device/shardings otherwise, and a bundle built on TPU
         # must still boot on CPU (and vice versa) — serve re-shards on load
-        params = jax.tree_util.tree_map(lambda x: jax.device_get(x), params)
+        params = jax.device_get(params)
         ckptr = ocp.StandardCheckpointer()
         ckptr.save((params_dir / "orbax").resolve(), params)
         ckptr.wait_until_finished()

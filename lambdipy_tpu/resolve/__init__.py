@@ -17,10 +17,13 @@ from lambdipy_tpu.resolve.requirements import (
     split_by_recipes,
 )
 from lambdipy_tpu.resolve.registry import ArtifactRegistry
+from lambdipy_tpu.resolve.releases import ReleaseFetcher, ReleaseStore
 from lambdipy_tpu.resolve.sources import SourceStore
 
 __all__ = [
     "ArtifactRegistry",
+    "ReleaseFetcher",
+    "ReleaseStore",
     "Requirement",
     "ResolutionError",
     "SourceStore",

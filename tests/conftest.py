@@ -32,6 +32,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _isolated_asset_cache(tmp_path, monkeypatch):
+    """Keep the release-asset download cache out of the real HOME."""
+    monkeypatch.setenv("LAMBDIPY_CACHE_DIR", str(tmp_path / "asset-cache"))
+
+
 @pytest.fixture()
 def tmp_registry(tmp_path):
     from lambdipy_tpu.resolve.registry import ArtifactRegistry
