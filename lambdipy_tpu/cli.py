@@ -79,17 +79,15 @@ def _pyver() -> str:
 def _registry_lookup(registry, recipe, pyver: str) -> str | None:
     """Artifact id under which this recipe is cached locally, or None.
 
-    Checks the locally computed id first, then any artifact recorded for
-    the same recipe+version (a prebuilt fetched for ``device=any`` is
-    published under the *asset's* artifact id, which can differ from the
-    id a device-pinned recipe computes)."""
+    Checks the locally computed id, then the ``device=any`` id for the
+    same recipe/version/python (a prebuilt asset published for ``any``
+    satisfies a device-pinned recipe, but nothing looser does — a
+    different python tag or concrete device must not be reused)."""
     exact = recipe.artifact_id(pyver)
-    if registry.has(exact):
-        return exact
-    matches = [a for a in registry.list()
-               if a.recipe == recipe.name and a.version == recipe.version]
-    if matches:
-        return max(matches, key=lambda a: a.created).artifact_id
+    any_id = f"{recipe.name}-{recipe.version}-py{pyver.replace('.', '')}-any"
+    for candidate in (exact, any_id):
+        if registry.has(candidate):
+            return candidate
     return None
 
 

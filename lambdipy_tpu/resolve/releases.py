@@ -30,6 +30,7 @@ from __future__ import annotations
 import gzip
 import json
 import os
+import re
 import shutil
 import tarfile
 import time
@@ -51,6 +52,23 @@ class ReleaseError(RuntimeError):
 # -- archive format ----------------------------------------------------------
 
 
+def _pack_entries(root: Path):
+    """Deterministic walk for packing: regular files, symlinks (including
+    symlinks to directories, which ``walk_files`` would drop — os.walk
+    files them under dirnames), and empty directories, so a fetched bundle
+    unpacks to exactly the tree that was published."""
+    for dirpath, dirnames, filenames in os.walk(root, followlinks=False):
+        dirnames.sort()
+        if not dirnames and not filenames and Path(dirpath) != Path(root):
+            yield Path(dirpath)
+        for name in sorted(dirnames):
+            p = Path(dirpath) / name
+            if p.is_symlink():
+                yield p
+        for name in sorted(filenames):
+            yield Path(dirpath) / name
+
+
 def pack_bundle(bundle_dir: Path, archive_path: Path) -> Path:
     """Pack a bundle tree into a deterministic ``.tar.gz``.
 
@@ -68,13 +86,13 @@ def pack_bundle(bundle_dir: Path, archive_path: Path) -> Path:
         # through keeps memory O(chunk) for multi-GB model bundles
         with gzip.GzipFile(filename="", fileobj=out, mode="wb", mtime=0) as gz:
             with tarfile.open(fileobj=gz, mode="w", format=tarfile.PAX_FORMAT) as tar:
-                for path in walk_files(bundle_dir):
+                for path in _pack_entries(bundle_dir):
                     info = tar.gettarinfo(
                         path, arcname=path.relative_to(bundle_dir).as_posix())
                     info.mtime = _EPOCH
                     info.uid = info.gid = 0
                     info.uname = info.gname = ""
-                    if info.issym():
+                    if info.issym() or info.isdir():
                         tar.addfile(info)
                     else:
                         with open(path, "rb") as f:
@@ -112,9 +130,17 @@ def unpack_archive(archive_path: Path, dest: Path) -> Path:
 # -- release store -----------------------------------------------------------
 
 
+_SAFE_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
 @dataclass(frozen=True)
 class Asset:
-    """One release asset: a packed bundle plus its index metadata."""
+    """One release asset: a packed bundle plus its index metadata.
+
+    Name/id fields are validated on construction (which covers every index
+    load): release.json is remote content, and these fields flow into
+    filesystem paths on the fetch side — a tampered index must not be able
+    to direct writes outside the cache/registry."""
 
     name: str  # "<recipe>-<version>-py<N>-<device>.tar.gz"
     tag: str  # release tag it belongs to
@@ -126,6 +152,13 @@ class Asset:
     python: str  # "3.12"
     device: str
     uploaded: float
+
+    def __post_init__(self):
+        for field_name in ("name", "tag", "artifact_id"):
+            value = getattr(self, field_name)
+            if not _SAFE_NAME_RE.match(value) or ".." in value:
+                raise ReleaseError(
+                    f"unsafe asset {field_name} {value!r} in release index")
 
 
 class ReleaseStore:

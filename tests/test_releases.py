@@ -67,6 +67,25 @@ def test_unpack_rejects_symlink_escape(tmp_path):
         unpack_archive(evil, tmp_path / "out")
 
 
+def test_pack_preserves_dir_symlinks_and_empty_dirs(bundle_dir, tmp_path):
+    (bundle_dir / "pkg-link").symlink_to("site/pkg", target_is_directory=True)
+    (bundle_dir / "empty").mkdir()
+    archive = pack_bundle(bundle_dir, tmp_path / "x.tar.gz")
+    out = unpack_archive(archive, tmp_path / "out")
+    assert (out / "pkg-link").is_symlink()
+    assert (out / "pkg-link" / "__init__.py").exists()
+    assert (out / "empty").is_dir()
+
+
+def test_asset_rejects_unsafe_index_fields():
+    from lambdipy_tpu.resolve.releases import Asset
+
+    with pytest.raises(ReleaseError, match="unsafe asset"):
+        Asset(name="x.tar.gz", tag="v1", size=1, hash="sha256:0",
+              artifact_id="../../escape", recipe="demo", version="0.1",
+              python="3.12", device="any", uploaded=0.0)
+
+
 @pytest.fixture()
 def store_with_asset(bundle_dir, tmp_path):
     store = ReleaseStore.create(tmp_path / "store")

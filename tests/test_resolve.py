@@ -94,3 +94,69 @@ def test_registry_delete(tmp_registry, tmp_path):
     tmp_registry.delete("a-1")
     assert not tmp_registry.has("a-1")
     assert tmp_registry.list() == []
+
+
+# -- Pipfile / Pipfile.lock / pyproject manifests ----------------------------
+
+
+def test_parse_pipfile():
+    from lambdipy_tpu.resolve.requirements import parse_pipfile_text
+
+    text = (
+        '[[source]]\nurl = "https://pypi.org/simple"\n\n'
+        "[packages]\n"
+        'numpy = "==2.0.2"\n'
+        'click = "*"\n'
+        'requests = {version = ">=2.0", extras = ["socks"]}\n\n'
+        "[dev-packages]\n"
+        'pytest = "*"\n'
+    )
+    reqs = parse_pipfile_text(text)
+    assert [r.name for r in reqs] == ["numpy", "click", "requests"]
+    assert reqs[0].specifier == "==2.0.2" and reqs[1].specifier == ""
+    dev = parse_pipfile_text(text, dev=True)
+    assert [r.name for r in dev] == ["numpy", "click", "requests", "pytest"]
+
+
+def test_parse_pipfile_rejects_vcs_entry():
+    from lambdipy_tpu.resolve.requirements import parse_pipfile_text
+
+    with pytest.raises(ResolutionError, match="git"):
+        parse_pipfile_text('[packages]\nfoo = {git = "https://x/y.git"}\n')
+
+
+def test_parse_pipfile_lock():
+    import json
+
+    from lambdipy_tpu.resolve.requirements import parse_pipfile_lock_text
+
+    doc = {
+        "default": {"numpy": {"version": "==2.0.2", "hashes": []},
+                    "click": {"version": "==8.4.2"}},
+        "develop": {"pytest": {"version": "==8.0.0"}},
+    }
+    reqs = parse_pipfile_lock_text(json.dumps(doc))
+    assert {(r.name, r.specifier) for r in reqs} == {
+        ("numpy", "==2.0.2"), ("click", "==8.4.2")}
+    dev = parse_pipfile_lock_text(json.dumps(doc), dev=True)
+    assert any(r.name == "pytest" for r in dev)
+    with pytest.raises(ResolutionError, match="missing pinned version"):
+        parse_pipfile_lock_text(json.dumps({"default": {"x": {}}}))
+
+
+def test_resolve_project_pipfile_and_pyproject(tmp_path):
+    pipfile = tmp_path / "Pipfile"
+    pipfile.write_text('[packages]\nnumpy = ">=2.0"\nclick = "*"\n')
+    res = resolve_project(pipfile, builtin_store())
+    assert [name for _, name in res.recipe_covered] == ["numpy"]
+    assert res.plain[0].name == "click" and res.plain[0].pinned
+
+    pyproject = tmp_path / "pyproject.toml"
+    pyproject.write_text(
+        '[project]\nname = "demo"\nversion = "0"\n'
+        'dependencies = ["numpy>=2.0", "click; python_version >= \'3.8\'", '
+        '"definitely-missing; python_version < \'3\'"]\n')
+    res = resolve_project(pyproject, builtin_store())
+    assert [name for _, name in res.recipe_covered] == ["numpy"]
+    # the false-marker dep is dropped, not a resolution error
+    assert [r.name for r in res.plain] == ["click"]
