@@ -83,8 +83,10 @@ def _registry_lookup(registry, recipe, pyver: str) -> str | None:
     same recipe/version/python (a prebuilt asset published for ``any``
     satisfies a device-pinned recipe, but nothing looser does — a
     different python tag or concrete device must not be reused)."""
+    import dataclasses
+
     exact = recipe.artifact_id(pyver)
-    any_id = f"{recipe.name}-{recipe.version}-py{pyver.replace('.', '')}-any"
+    any_id = dataclasses.replace(recipe, device="any").artifact_id(pyver)
     for candidate in (exact, any_id):
         if registry.has(candidate):
             return candidate
@@ -374,14 +376,16 @@ def _resolve_bundle(name_or_dir: str, registry_dir) -> Path:
 @click.option("--port", type=int, default=0)
 @click.option("--registry", "registry_dir", type=click.Path(), default=None)
 @click.option("--timeout", type=float, default=300.0)
-def deploy_cmd(bundle, name, port, registry_dir, timeout):
+@click.option("--watchdog/--no-watchdog", default=True,
+              help="run under the restart supervisor (crash -> respawn)")
+def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
     """Deploy a built bundle to the local TPU runtime."""
     from lambdipy_tpu.runtime.deploy import LocalRuntime
 
     bundle_dir = _resolve_bundle(bundle, registry_dir)
     dep_name = name or bundle.split("/")[-1]
     dep = LocalRuntime().deploy(dep_name, bundle_dir, port=port,
-                                ready_timeout=timeout)
+                                ready_timeout=timeout, watchdog=watchdog)
     click.echo(json.dumps({"name": dep.name, "url": dep.url,
                            "cold_start": dep.cold_start}))
 

@@ -133,7 +133,10 @@ def parse_pipfile_lock_text(text: str, *, dev: bool = False) -> list[Requirement
             if not isinstance(spec, dict) or "version" not in spec:
                 raise ResolutionError(
                     f"Pipfile.lock entry {name!r}: missing pinned version")
-            out.append(parse_requirement(f"{name}{spec['version']}"))
+            line = f"{name}{spec['version']}"
+            if spec.get("markers"):  # other-platform pins must not abort resolution
+                line += f"; {spec['markers']}"
+            out.append(parse_requirement(line))
     return out
 
 

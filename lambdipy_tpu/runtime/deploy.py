@@ -72,9 +72,13 @@ class LocalRuntime:
         atomic_write_text(self.state_path, json.dumps(state, indent=1))
 
     def deploy(self, name: str, bundle_dir: Path, *, port: int = 0,
-               ready_timeout: float = 300.0, env: dict | None = None) -> Deployment:
+               ready_timeout: float = 300.0, env: dict | None = None,
+               watchdog: bool = True) -> Deployment:
         """Spawn a server for the bundle and wait until it reports ready.
 
+        ``watchdog`` (default) runs the server under the restart supervisor
+        (SURVEY.md §6 failure-detection row): a crashed server is respawned
+        on the same port with backoff, so the deployment URL self-heals.
         ``ready_timeout`` is generous because cold start includes PJRT init
         + first compile on a cold compile cache (BASELINE.md ~10 s floor).
         """
@@ -82,8 +86,9 @@ class LocalRuntime:
         state = self._load()
         if name in state:
             raise DeployError(f"deployment {name!r} already exists; stop it first")
-        cmd = [sys.executable, "-m", "lambdipy_tpu.runtime.server",
-               str(bundle_dir), str(port)]
+        module = ("lambdipy_tpu.runtime.supervisor" if watchdog
+                  else "lambdipy_tpu.runtime.server")
+        cmd = [sys.executable, "-m", module, str(bundle_dir), str(port)]
         full_env = dict(os.environ)
         full_env.update(env or {})
         # the framework itself must be importable in the server process
@@ -124,7 +129,9 @@ class LocalRuntime:
                 ready_line = parsed
                 break
         if ready_line is None:
-            proc.kill()
+            # group-kill: with the watchdog a supervisor fronts the server,
+            # and killing only the supervisor would orphan the booting child
+            _signal_group(proc.pid, signal.SIGKILL)
             raise DeployError(
                 f"deployment {name!r} not ready within {ready_timeout}s; "
                 f"log tail ({log_path}):\n{_log_tail()}")
@@ -157,21 +164,19 @@ class LocalRuntime:
         return _http_json(f"{self.get(name).url}/metrics")
 
     def stop(self, name: str, *, grace: float = 5.0) -> None:
+        """Drain via /shutdown, escalate to SIGTERM, then SIGKILL the whole
+        process group (deploys start a new session, so this reaps the
+        supervisor AND its server child — a bare SIGKILL on the supervisor
+        would orphan the serving process)."""
         dep = self.get(name)
         try:
             _http_json(f"{dep.url}/shutdown", {})
         except Exception:
             pass
-        deadline = time.monotonic() + grace
-        while time.monotonic() < deadline:
-            if not _pid_alive(dep.pid):
-                break
-            time.sleep(0.1)
-        if _pid_alive(dep.pid):
-            try:
-                os.kill(dep.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
+        if not _wait_dead(dep.pid, grace):
+            _signal_group(dep.pid, signal.SIGTERM)
+            if not _wait_dead(dep.pid, grace):
+                _signal_group(dep.pid, signal.SIGKILL)
         state = self._load()
         state.pop(name, None)
         self._save(state)
@@ -190,3 +195,24 @@ def _pid_alive(pid: int) -> bool:
         return True
     except (ProcessLookupError, PermissionError):
         return False
+
+
+def _wait_dead(pid: int, grace: float) -> bool:
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if not _pid_alive(pid):
+            return True
+        time.sleep(0.1)
+    return not _pid_alive(pid)
+
+
+def _signal_group(pid: int, sig: int) -> None:
+    """Signal the deployment's process group, falling back to the single
+    pid if the group is gone."""
+    try:
+        os.killpg(pid, sig)
+    except (ProcessLookupError, PermissionError):
+        try:
+            os.kill(pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass

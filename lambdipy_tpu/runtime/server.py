@@ -16,6 +16,8 @@ return 500 with the error type and are counted; the process stays up.
 from __future__ import annotations
 
 import json
+import os
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -34,6 +36,9 @@ class BundleServer:
         self.bundle_dir = Path(bundle_dir)
         self.stats = LatencyStats()
         self._profile_lock = threading.Lock()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self.draining = False
         self.started = time.time()
         self.boot: BootReport = load_bundle(self.bundle_dir, warmup=warmup)
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
@@ -61,6 +66,8 @@ class BundleServer:
                 if self.path == "/healthz":
                     self._send(200, {
                         "ok": True,
+                        "pid": os.getpid(),
+                        "draining": server_self.draining,
                         "bundle": str(server_self.bundle_dir),
                         "uptime_s": round(time.time() - server_self.started, 1),
                         "cold_start": server_self.boot.stages,
@@ -125,9 +132,22 @@ class BundleServer:
                 if self.path != "/invoke":
                     self._send(404, {"ok": False, "error": "not found"})
                     return
+                # body must be consumed before any early reply: on a
+                # keep-alive connection unread body bytes would be parsed
+                # as the next request line
                 request = self._read_json()
                 if request is None:
                     server_self.stats.record_error()
+                    return
+                # draining check and in-flight increment are one atomic
+                # step: stop() can then never observe inflight==0 while an
+                # accepted invoke is still on its way to dispatch
+                with server_self._inflight_lock:
+                    draining = server_self.draining
+                    if not draining:
+                        server_self._inflight += 1
+                if draining:
+                    self._send(503, {"ok": False, "error": "draining"})
                     return
                 t0 = time.monotonic()
                 try:
@@ -140,6 +160,9 @@ class BundleServer:
                     self._send(500, {"ok": False, "error": str(e),
                                      "kind": type(e).__name__})
                     return
+                finally:
+                    with server_self._inflight_lock:
+                        server_self._inflight -= 1
                 server_self.stats.record((time.monotonic() - t0) * 1e3)
                 self._send(200, result)
 
@@ -156,7 +179,15 @@ class BundleServer:
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, *, drain_grace: float = 10.0):
+        """Drain then stop: new invokes get 503 while in-flight ones finish
+        (handler threads are daemonic — without this wait a process exit
+        would cut device work mid-dispatch)."""
+        with self._inflight_lock:
+            self.draining = True
+        deadline = time.monotonic() + drain_grace
+        while self._inflight > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -164,8 +195,6 @@ class BundleServer:
 def main(argv=None) -> int:
     """``python -m lambdipy_tpu.runtime.server <bundle_dir> [port]``"""
     import sys
-
-    import os
 
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
@@ -177,8 +206,17 @@ def main(argv=None) -> int:
     bundle = Path(argv[0])
     port = int(argv[1]) if len(argv) > 1 else 0
     server = BundleServer(bundle, port=port)
+
+    # SIGTERM = graceful drain (supervisor/controller stop path). stop()
+    # must run off the serve_forever thread — shutdown() from inside the
+    # serving thread deadlocks — so the handler hands it to a worker.
+    def _term(signum, frame):
+        threading.Thread(target=server.stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+
     # readiness line on stdout: the deploy controller parses this
-    print(json.dumps({"ready": True, "port": server.port,
+    print(json.dumps({"ready": True, "pid": os.getpid(), "port": server.port,
                       "cold_start": server.boot.stages}), flush=True)
     try:
         server.serve_forever()

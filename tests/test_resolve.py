@@ -160,3 +160,18 @@ def test_resolve_project_pipfile_and_pyproject(tmp_path):
     assert [name for _, name in res.recipe_covered] == ["numpy"]
     # the false-marker dep is dropped, not a resolution error
     assert [r.name for r in res.plain] == ["click"]
+
+
+def test_pipfile_lock_other_platform_marker_dropped(tmp_path):
+    import json
+
+    lock = tmp_path / "Pipfile.lock"
+    lock.write_text(json.dumps({
+        "default": {
+            "numpy": {"version": "==2.0.2"},
+            "colorama": {"version": "==0.4.6",
+                         "markers": "sys_platform == 'win32'"},
+        }}))
+    res = resolve_project(lock, builtin_store())
+    names = [name for _, name in res.recipe_covered] + [r.name for r in res.plain]
+    assert "numpy" in names and "colorama" not in names

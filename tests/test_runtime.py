@@ -185,3 +185,82 @@ def test_profile_endpoint_captures_trace(llama_bundle):
         assert Path(out["dir"]).is_dir()
     finally:
         server.stop()
+
+
+@pytest.mark.slow
+def test_watchdog_restarts_killed_server(llama_bundle, tmp_path):
+    """Fault injection (SURVEY.md §6): SIGKILL the serving process mid-life;
+    the supervisor must respawn it on the same port and invokes recover."""
+    import os
+    import signal
+    import time
+
+    from lambdipy_tpu.runtime.deploy import LocalRuntime
+
+    rt = LocalRuntime(tmp_path / "deployments.json")
+    dep = rt.deploy("wd", llama_bundle, env={
+        "LAMBDIPY_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    })
+    try:
+        first = rt.health("wd")
+        assert first["ok"] and not first["draining"]
+        server_pid = first["pid"]
+        assert server_pid != dep.pid  # supervisor fronts a distinct worker
+        os.kill(server_pid, signal.SIGKILL)  # crash the worker, not the sup
+        deadline = time.monotonic() + 120
+        second = None
+        while time.monotonic() < deadline:
+            try:
+                second = rt.health("wd")
+                if second["pid"] != server_pid:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert second is not None and second["pid"] != server_pid, \
+            "server was not respawned"
+        out = rt.invoke("wd", {"tokens": [1, 2], "max_new_tokens": 2})
+        assert out["ok"]
+    finally:
+        rt.stop("wd")
+    assert rt.list() == []
+
+
+def test_supervisor_gives_up_after_max_restarts(tmp_path):
+    """A bundle that can never boot must not restart-loop forever."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["LAMBDIPY_MAX_RESTARTS"] = "1"
+    repo_root = str(Path(__file__).resolve().parents[1])
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    r = subprocess.run(
+        [_sys.executable, "-m", "lambdipy_tpu.runtime.supervisor",
+         str(tmp_path / "not-a-bundle")],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 1
+    assert "giving up" in r.stderr
+
+
+def test_server_drain_rejects_new_invokes(llama_bundle):
+    import threading
+    import urllib.error
+
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    server = BundleServer(llama_bundle, port=0).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        assert _post(f"{base}/invoke", {"tokens": [1], "max_new_tokens": 1})["ok"]
+        server.draining = True
+        assert _get(f"{base}/healthz")["draining"]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/invoke", {"tokens": [1]})
+        assert e.value.code == 503
+    finally:
+        server.draining = False
+        threading.Thread(target=server.stop, daemon=True).start()
