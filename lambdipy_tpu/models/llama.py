@@ -42,6 +42,12 @@ class LlamaConfig:
     # (Pallas kernel when shapes tile), or "ring" (sequence-parallel ring
     # attention over the ambient mesh's sp axis — the long-context path).
     attn_backend: str = "dense"
+    # Sparse MoE FFN (Mixtral-style): >0 replaces the dense SwiGLU with
+    # moe_experts top-k routed experts (models/moe.py), expert dim sharded
+    # over the mesh's ep axis.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -192,10 +198,16 @@ class LlamaBlock(nn.Module):
         x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, name="o_proj")(out)
 
         h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
-        gate = QDense(cfg.mlp, cfg.quant, cfg.dtype, name="gate_proj")(h)
-        up = QDense(cfg.mlp, cfg.quant, cfg.dtype, name="up_proj")(h)
-        x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, name="down_proj")(
-            nn.silu(gate) * up)
+        if cfg.moe_experts:
+            from lambdipy_tpu.models.moe import MoEMLP
+
+            x = x + MoEMLP(cfg.moe_experts, cfg.mlp, cfg.moe_top_k,
+                           cfg.moe_capacity_factor, cfg.dtype, name="moe")(h)
+        else:
+            gate = QDense(cfg.mlp, cfg.quant, cfg.dtype, name="gate_proj")(h)
+            up = QDense(cfg.mlp, cfg.quant, cfg.dtype, name="up_proj")(h)
+            x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, name="down_proj")(
+                nn.silu(gate) * up)
         return x, new_cache
 
 
@@ -276,30 +288,150 @@ def quantize_params(float_params):
     return convert(float_params)
 
 
-def greedy_generate(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
-                    max_len: int | None = None):
-    """Greedy decode: prefill once, then ``lax.scan`` one compiled step per
-    token. prompt_tokens: [b, s] int32. Returns [b, max_new_tokens]."""
+def pipeline_forward(model: LlamaModel, params, tokens, mesh, *,
+                     num_microbatches: int):
+    """Forward scoring with the transformer blocks pipeline-parallel over
+    the mesh's ``pp`` axis (GPipe microbatching, parallel/pipeline.py).
+
+    Embedding and the final norm/lm_head run replicated outside the
+    pipeline (they are a small fraction of FLOPs); the ``layers`` blocks are
+    split into ``pp`` equal stages. Layer count must divide by pp size.
+    """
+    from lambdipy_tpu.parallel.pipeline import (
+        merge_microbatches, pipeline_apply, split_microbatches,
+        stack_stage_params)
+
+    cfg = model.cfg
+    p = params["params"]
+    n_stages = mesh.shape["pp"]
+    if cfg.layers % n_stages:
+        raise ValueError(f"{cfg.layers} layers not divisible by pp={n_stages}")
+    per_stage = cfg.layers // n_stages
+    layer_trees = [p[f"layer_{i}"] for i in range(cfg.layers)]
+    stage_trees = [
+        jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                               *layer_trees[s * per_stage:(s + 1) * per_stage])
+        for s in range(n_stages)
+    ]
+    stacked = stack_stage_params(stage_trees)  # leading dims [pp, per_stage, ...]
+
+    b, s = tokens.shape
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
+    block = LlamaBlock(cfg)
+    # batch dim 1: broadcasts against any local microbatch size, so the
+    # replicated const stays valid when pipeline_apply also shards the
+    # microbatch dim over dp/fsdp
+    const = {
+        "positions": jnp.arange(s)[None, :],
+        "mask": jnp.ones((1, s), jnp.bool_),
+    }
+
+    def stage_fn(stage_params, h, const):
+        for j in range(per_stage):
+            layer = jax.tree_util.tree_map(lambda q, j=j: q[j], stage_params)
+            h, _ = block.apply({"params": layer}, h, const["positions"],
+                               const["mask"], None)
+        return h
+
+    x = jnp.take(p["embed"]["embedding"], tokens, axis=0)
+    x = merge_microbatches(pipeline_apply(
+        stage_fn, stacked, split_microbatches(x, num_microbatches), mesh,
+        const=const))
+    x = RMSNorm(cfg.norm_eps).apply({"params": p["final_norm"]}, x)
+    return QDense(cfg.vocab_size, cfg.quant, jnp.float32).apply(
+        {"params": p["lm_head"]}, x)
+
+
+def filter_logits(logits, *, top_k: int | None = None, top_p: float | None = None):
+    """Mask logits outside the top-k / nucleus (top-p) sets to -inf.
+
+    logits: [b, v] fp32. Static top_k/top_p (compile-time), the standard
+    serving knobs. The highest-probability token is always kept.
+    """
+    neg = jnp.float32(-1e30)
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None and top_p < 1.0:
+        sort = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sort, axis=-1)
+        # keep while cumulative prob *before* this token is < top_p; the
+        # head token is kept unconditionally so top_p <= 0 degrades to
+        # greedy instead of masking the whole vocabulary
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        keep = keep.at[..., 0].set(True)
+        thresh = jnp.min(jnp.where(keep, sort, jnp.float32(jnp.inf)),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, neg, logits)
+    return logits
+
+
+def _decode(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
+            max_len: int | None, select_fn, rng, eos_id: int | None):
+    """Shared decode loop: prefill once, then ``lax.scan`` one compiled
+    step per token; ``select_fn(logits_f32, rng) -> next token ids``."""
     cfg = model.cfg
     b, s = prompt_tokens.shape
     max_len = max_len or min(cfg.max_len, s + max_new_tokens)
 
     logits, prefill_cache = model.apply(params, prompt_tokens)
     cache = prefill_into_cache(cfg, prefill_cache, b, max_len, s)
-    first_token = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    rng, sub = jax.random.split(rng)
+    first_token = select_fn(logits[:, -1, :].astype(jnp.float32), sub)
+    done0 = (first_token == eos_id) if eos_id is not None else jnp.zeros(b, jnp.bool_)
 
     def step(carry, _):
-        tok, cache, pos = carry
+        tok, cache, pos, done, rng = carry
         positions = jnp.broadcast_to(pos[None, None], (b, 1))
         logits, new_cache = model.apply(params, tok[:, None], positions=positions,
                                         cache=cache)
         for entry in new_cache:
             entry["index"] = pos + 1
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-        return (nxt, new_cache, pos + 1), tok
+        rng, sub = jax.random.split(rng)
+        nxt = select_fn(logits[:, -1, :].astype(jnp.float32), sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, new_cache, pos + 1, done, rng), tok
 
     for entry in cache:
         entry["index"] = jnp.int32(s)
-    (_, _, _), toks = jax.lax.scan(
-        step, (first_token, cache, jnp.int32(s)), None, length=max_new_tokens)
+    (_, _, _, _, _), toks = jax.lax.scan(
+        step, (first_token, cache, jnp.int32(s), done0, rng), None,
+        length=max_new_tokens)
     return jnp.transpose(toks)  # [b, max_new_tokens]
+
+
+def greedy_generate(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
+                    max_len: int | None = None, eos_id: int | None = None):
+    """Greedy decode. prompt_tokens: [b, s] int32 -> [b, max_new_tokens].
+    After ``eos_id`` (when given) a sequence keeps emitting ``eos_id``."""
+
+    def select(logits, _rng):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return _decode(model, params, prompt_tokens, max_new_tokens=max_new_tokens,
+                   max_len=max_len, select_fn=select,
+                   rng=jax.random.PRNGKey(0), eos_id=eos_id)
+
+
+def sample_generate(model: LlamaModel, params, prompt_tokens, *, rng,
+                    max_new_tokens: int, temperature: float = 1.0,
+                    top_k: int | None = None, top_p: float | None = None,
+                    max_len: int | None = None, eos_id: int | None = None):
+    """Stochastic decode: temperature + top-k + nucleus filtering, one
+    categorical draw per step from the shared ``lax.scan`` loop.
+    temperature <= 0 degrades to greedy."""
+    if temperature <= 0.0:
+        return greedy_generate(model, params, prompt_tokens,
+                               max_new_tokens=max_new_tokens, max_len=max_len,
+                               eos_id=eos_id)
+
+    def select(logits, rng):
+        logits = filter_logits(logits / jnp.float32(temperature),
+                               top_k=top_k, top_p=top_p)
+        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+    return _decode(model, params, prompt_tokens, max_new_tokens=max_new_tokens,
+                   max_len=max_len, select_fn=select, rng=rng, eos_id=eos_id)

@@ -1,0 +1,131 @@
+"""Sparse Mixture-of-Experts MLP with expert parallelism over ``ep``.
+
+New TPU-first surface (the reference has no model code at all — SURVEY.md
+§3.2); this is the Mixtral-style sparse FFN for the Llama family
+(models/llama.py wires it in when ``LlamaConfig.moe_experts > 0``).
+
+TPU-first choices:
+- **Dense dispatch** (GShard/Switch formulation): routing becomes one-hot
+  einsums over a *static* expert-capacity dim — [tokens, experts, capacity]
+  dispatch/combine tensors, no gather/scatter, no dynamic shapes, everything
+  tiles onto the MXU and jits cleanly. Overflow tokens are dropped (their
+  residual path carries them), the standard capacity-factor trade.
+- **Expert parallelism is annotation**: expert-stacked weights [E, ...]
+  shard ``P("ep", ...)`` via the registry rules, and the dispatched
+  activations get a ``with_sharding_constraint`` so XLA inserts the
+  all-to-all over ICI (scaling-book recipe; no hand-rolled transport).
+- fp32 router and softmax (routing is precision-sensitive), bf16 expert
+  matmuls; the load-balance auxiliary loss (Switch eq. 4 shape) is sown as
+  an intermediate for the train step to read.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _ep_constraint(x, spec_entries):
+    """Constrain ``x``'s sharding when an ep-carrying mesh is ambient."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lambdipy_tpu.parallel.mesh import current_mesh
+    from lambdipy_tpu.parallel.sharding import _filter_spec
+
+    mesh = current_mesh()
+    if mesh is None or "ep" not in mesh.axis_names:
+        return x
+    spec = _filter_spec(P(*spec_entries), mesh, x.ndim)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def route_topk(probs, top_k: int, capacity: int):
+    """GShard-style top-k routing with a static per-expert capacity.
+
+    probs: [t, e] fp32 router probabilities. Returns
+    (dispatch [t, e, c] {0,1}, combine [t, e, c] fp32, aux_loss scalar).
+    Slot priority: all tokens' first choices are seated before any second
+    choice, so a token's top expert is the last to drop it on overflow.
+    """
+    t, e = probs.shape
+    gates, idx = jax.lax.top_k(probs, top_k)  # [t, k]
+    gates = gates / jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [t, k, e]
+
+    # accumulate per slot (static tiny top_k loop) so peak memory stays at
+    # the [t, e, c] of the result tensors instead of top_k times that
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)  # queue length after prior slots
+    for slot in range(top_k):
+        oh = onehot[:, slot, :]  # [t, e]
+        pos = jnp.cumsum(oh, axis=0) - 1.0 + counts[None, :]
+        keep = (pos < capacity) & (oh > 0)
+        seated = jnp.where(
+            keep[..., None],
+            jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32),
+            0.0)  # [t, e, c]
+        dispatch = dispatch + seated
+        combine = combine + seated * gates[:, slot][:, None, None]
+        counts = counts + jnp.sum(oh, axis=0)
+
+    # Switch-Transformer load-balance loss: E * <frac tokens per expert> ·
+    # <mean router prob per expert>; minimized at uniform routing
+    frac_tokens = jnp.mean(onehot[:, 0, :], axis=0)  # first-choice assignment
+    mean_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * mean_probs)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU experts, expert dim sharded over ``ep``."""
+
+    num_experts: int
+    mlp: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, hidden = x.shape
+        e, m = self.num_experts, self.mlp
+        tokens = x.reshape(b * s, hidden)
+        t = tokens.shape[0]
+        capacity = max(1, int(self.capacity_factor * self.top_k * t / e))
+
+        router = self.param("router", nn.initializers.lecun_normal(),
+                            (hidden, e), jnp.float32)
+        probs = jax.nn.softmax(tokens.astype(jnp.float32) @ router, axis=-1)
+        dispatch, combine, aux = route_topk(probs, self.top_k, capacity)
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w_gate = self.param("experts_gate", init, (e, hidden, m), self.dtype)
+        w_up = self.param("experts_up", init, (e, hidden, m), self.dtype)
+        w_down = self.param("experts_down", init, (e, m, hidden), self.dtype)
+
+        # dispatch all-to-all: tokens (dp-sharded) -> expert shards (ep)
+        xe = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
+                        tokens.astype(self.dtype))
+        xe = _ep_constraint(xe, ("ep", None, None))
+        gate = jnp.einsum("ech,ehm->ecm", xe, w_gate)
+        up = jnp.einsum("ech,ehm->ecm", xe, w_up)
+        ye = jnp.einsum("ecm,emh->ech", nn.silu(gate) * up, w_down)
+        ye = _ep_constraint(ye, ("ep", None, None))
+        # combine all-to-all back to token order, weighted by router gates
+        out = jnp.einsum("tec,ech->th", combine.astype(self.dtype), ye)
+        return out.reshape(b, s, hidden).astype(x.dtype)
+
+
+def moe_aux_loss(intermediates) -> jax.Array:
+    """Sum every sown ``moe_aux_loss`` in an intermediates collection."""
+    leaves = [
+        jnp.sum(jnp.asarray(v))
+        for path, v in jax.tree_util.tree_leaves_with_path(intermediates)
+        if any(getattr(k, "key", None) == "moe_aux_loss" for k in path)
+    ]
+    return sum(leaves, jnp.float32(0.0))

@@ -69,6 +69,10 @@ class JaxModel:
     forward: Callable[..., Any]  # (params, *batch) -> output
     generate: Callable[..., Any] | None = None
     config: Any = None
+    # (params, *batch) -> (output, aux_loss) for models with an auxiliary
+    # training loss (MoE router balance); feed to sharded_train_step's
+    # model_apply_aux so the router receives its balance gradient
+    forward_with_aux: Callable[..., Any] | None = None
 
     def init_params(self, seed: int = 0, batch_size: int = 1):
         import jax
@@ -206,22 +210,46 @@ def _llama_tp_rules():
         ("*_proj/scale", P(None, "tp")),
         ("*lm_head/kernel*", P(None, "tp")),
         ("*lm_head/scale", P(None, "tp")),
+        # MoE experts: expert dim over ep, expert-hidden over tp; router
+        # replicated (tiny, fp32, routing must agree across shards)
+        ("*moe/experts_gate", P("ep", None, "tp")),
+        ("*moe/experts_up", P("ep", None, "tp")),
+        ("*moe/experts_down", P("ep", "tp", None)),
+        ("*moe/router", P()),
     ))
 
 
 def _build_llama(cfg) -> JaxModel:
     import jax.numpy as jnp
 
-    from lambdipy_tpu.models.llama import LlamaModel, greedy_generate
+    from lambdipy_tpu.models.llama import LlamaModel, greedy_generate, sample_generate
 
     module = LlamaModel(cfg)
 
     def example_batch(batch_size: int):
         return (jnp.zeros((batch_size, 16), jnp.int32),)
 
-    def generate(params, prompt, max_new_tokens=16, max_len=None):
+    def generate(params, prompt, max_new_tokens=16, max_len=None, *,
+                 temperature=0.0, top_k=None, top_p=None, seed=0, eos_id=None):
+        if temperature and temperature > 0.0:
+            import jax
+
+            return sample_generate(
+                module, params, prompt, rng=jax.random.PRNGKey(seed),
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, max_len=max_len, eos_id=eos_id)
         return greedy_generate(module, params, prompt,
-                               max_new_tokens=max_new_tokens, max_len=max_len)
+                               max_new_tokens=max_new_tokens, max_len=max_len,
+                               eos_id=eos_id)
+
+    forward_with_aux = None
+    if cfg.moe_experts:
+        from lambdipy_tpu.models.moe import moe_aux_loss
+
+        def forward_with_aux(params, tokens):
+            (logits, _), state = module.apply(params, tokens,
+                                              mutable=["intermediates"])
+            return logits, moe_aux_loss(state["intermediates"])
 
     return JaxModel(
         module=module,
@@ -230,6 +258,7 @@ def _build_llama(cfg) -> JaxModel:
         forward=lambda params, tokens: module.apply(params, tokens)[0],
         generate=generate,
         config=cfg,
+        forward_with_aux=forward_with_aux,
     )
 
 
@@ -244,6 +273,21 @@ def _build_llama3_8b(dtype: str = "bfloat16", quant: str | None = "int8",
     cfg = dataclasses.replace(
         LLAMA3_8B, dtype=_dtype(dtype), quant=quant,
         max_len=int(extra.get("max_len", 8192)))
+    return _build_llama(cfg)
+
+
+@register("llama-moe-tiny", "jax", "tiny MoE Llama (expert-parallel tests/dry-runs)")
+def _build_llama_moe_tiny(dtype: str = "float32", quant: str | None = None,
+                          extra: dict | None = None) -> JaxModel:
+    import dataclasses
+
+    from lambdipy_tpu.models.llama import LLAMA_TINY
+
+    extra = extra or {}
+    cfg = dataclasses.replace(
+        LLAMA_TINY, dtype=_dtype(dtype), quant=quant,
+        moe_experts=int(extra.get("moe_experts", 4)),
+        moe_top_k=int(extra.get("moe_top_k", 2)))
     return _build_llama(cfg)
 
 

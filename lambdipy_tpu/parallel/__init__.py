@@ -14,6 +14,12 @@ from lambdipy_tpu.parallel.mesh import (
     make_mesh,
     mesh_shape_for,
 )
+from lambdipy_tpu.parallel.pipeline import (
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+)
 from lambdipy_tpu.parallel.sharding import (
     ShardingRules,
     named_sharding,
@@ -26,8 +32,12 @@ __all__ = [
     "ShardingRules",
     "flat_mesh",
     "make_mesh",
+    "merge_microbatches",
     "mesh_shape_for",
     "named_sharding",
+    "pipeline_apply",
     "shard_batch",
     "shard_params",
+    "split_microbatches",
+    "stack_stage_params",
 ]

@@ -1,0 +1,143 @@
+"""Multi-host bootstrap: PJRT coordination + hybrid ICI/DCN meshes.
+
+The reference's only "communication" is HTTP to GitHub (SURVEY.md §6
+distributed row); this is the rebuild's scale-out surface. One slice talks
+over ICI; multiple slices/hosts coordinate through the PJRT distributed
+service (``jax.distributed``) and exchange data over DCN. The design rule
+(scaling-book): DCN-adjacent mesh axes go *outermost*, ICI-heavy axes
+innermost, so bandwidth-hungry collectives (TP all-reduces, FSDP
+all-gathers) never cross a slice boundary.
+
+Nothing here hand-rolls transport — XLA emits every collective; this module
+only (a) initializes the coordination service from the environment and
+(b) builds meshes whose device order respects the ICI/DCN topology.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from lambdipy_tpu.parallel.mesh import MESH_AXES
+from lambdipy_tpu.utils.logs import get_logger, log_event
+
+log = get_logger("lambdipy.distributed")
+
+# env surface (first hit wins): ours, then the standard JAX names
+_COORD_VARS = ("LAMBDIPY_COORDINATOR", "JAX_COORDINATOR_ADDRESS")
+_NPROC_VARS = ("LAMBDIPY_NUM_PROCESSES", "JAX_NUM_PROCESSES")
+_PID_VARS = ("LAMBDIPY_PROCESS_ID", "JAX_PROCESS_ID")
+
+
+@dataclass(frozen=True)
+class DistributedContext:
+    """What this process knows about the job after bootstrap."""
+
+    initialized: bool  # did we start the coordination service
+    process_index: int
+    process_count: int
+    coordinator: str | None = None
+
+    @property
+    def is_primary(self) -> bool:
+        return self.process_index == 0
+
+
+def _env_first(names) -> str | None:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return None
+
+
+def initialize_from_env(*, timeout_s: float | None = None) -> DistributedContext:
+    """Start ``jax.distributed`` when the environment describes a multi-
+    process job; single-process (or already-initialized) is a clean no-op.
+
+    A job is multi-process when a coordinator address AND a process count
+    > 1 are present (TPU pod slices auto-populate these through the plugin;
+    explicit env wins for the serverless runtime's process launcher).
+    """
+    coord = _env_first(_COORD_VARS)
+    nproc = _env_first(_NPROC_VARS)
+    pid = _env_first(_PID_VARS)
+    if coord and nproc and int(nproc) > 1:
+        kwargs = {}
+        if timeout_s is not None:
+            kwargs["initialization_timeout"] = int(timeout_s)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(nproc),
+                process_id=int(pid) if pid is not None else None,
+                **kwargs)
+            log_event(log, "distributed init", coordinator=coord, nproc=int(nproc))
+            return DistributedContext(True, jax.process_index(),
+                                      jax.process_count(), coord)
+        except RuntimeError as e:
+            if "already initialized" not in str(e).lower():
+                raise
+    return DistributedContext(False, jax.process_index(), jax.process_count(),
+                              coord)
+
+
+def make_hybrid_mesh(ici: dict[str, int], dcn: dict[str, int] | None = None,
+                     devices=None) -> Mesh:
+    """Mesh whose per-axis size is ``ici[a] * dcn[a]``, device order laid
+    out so the dcn factor of every axis is outermost (slice-major).
+
+    Single-slice jobs (all dcn factors 1) reduce to a plain mesh. Axis
+    names/order follow :data:`MESH_AXES`.
+    """
+    dcn = dict(dcn or {})
+    unknown = (set(ici) | set(dcn)) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; known: {MESH_AXES}")
+    devices = list(devices if devices is not None else jax.devices())
+    axes = [a for a in MESH_AXES
+            if ici.get(a, 1) * dcn.get(a, 1) > 1] or ["dp"]
+    sizes = {a: ici.get(a, 1) * dcn.get(a, 1) for a in axes}
+    if math.prod(sizes.values()) != len(devices):
+        raise ValueError(
+            f"hybrid mesh {sizes} needs {math.prod(sizes.values())} devices, "
+            f"have {len(devices)}")
+
+    if math.prod(dcn.values()) == 1:
+        arr = np.asarray(devices).reshape([sizes[a] for a in axes])
+        return Mesh(arr, axis_names=tuple(axes))
+
+    if hasattr(devices[0], "slice_index"):
+        # real multi-slice topology: let mesh_utils read it
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=[ici.get(a, 1) for a in axes],
+            dcn_mesh_shape=[dcn.get(a, 1) for a in axes],
+            devices=devices)
+    else:
+        # no slice topology exposed (CPU emulation / single-host): same
+        # slice-major layout, with contiguous device blocks standing in for
+        # slices — the dcn factor of every axis lands outermost
+        dshape = [dcn.get(a, 1) for a in axes]
+        ishape = [ici.get(a, 1) for a in axes]
+        arr = np.asarray(devices).reshape(dshape + ishape)
+        n = len(axes)
+        arr = arr.transpose([x for i in range(n) for x in (i, n + i)])
+        arr = arr.reshape([d * i for d, i in zip(dshape, ishape)])
+    return Mesh(arr, axis_names=tuple(axes))
+
+
+def process_batch_slice(global_batch: int) -> tuple[int, int]:
+    """(local_batch, offset) for this process's equal share of a global
+    batch — the data-loading contract for multi-host input pipelines."""
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} processes")
+    local = global_batch // n
+    return local, local * jax.process_index()

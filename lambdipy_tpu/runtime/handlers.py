@@ -238,7 +238,8 @@ def text_classify_handler(spec: dict, ctx) -> HandlerState:
 
 
 def generate_handler(spec: dict, ctx) -> HandlerState:
-    """Config 5: Llama TP int8 greedy generation."""
+    """Config 5: Llama TP int8 generation (greedy by default; requests may
+    set temperature / top_k / top_p / seed / eos_id for sampled decode)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -247,11 +248,13 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     params, mesh = _maybe_shard(adapter, params, spec)
     default_new = int((spec.get("extra") or {}).get("max_new_tokens", 16))
 
-    def run(prompt, max_new):
+    def run(prompt, max_new, sample_kwargs):
         if mesh is not None:
             with mesh:
-                return adapter.generate(params, prompt, max_new_tokens=max_new)
-        return adapter.generate(params, prompt, max_new_tokens=max_new)
+                return adapter.generate(params, prompt, max_new_tokens=max_new,
+                                        **sample_kwargs)
+        return adapter.generate(params, prompt, max_new_tokens=max_new,
+                                **sample_kwargs)
 
     def invoke(req: dict) -> dict:
         if req.get("warmup") or req.get("random"):
@@ -260,7 +263,15 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             raw = np.asarray(req["tokens"], dtype=np.int32)
             prompt = jnp.asarray(raw[None, :] if raw.ndim == 1 else raw)
         max_new = int(req.get("max_new_tokens", default_new))
-        toks = np.asarray(jax.device_get(run(prompt, max_new)))
+        # every knob tolerates JSON null (= "use the default")
+        sample_kwargs = {
+            "temperature": float(req.get("temperature") or 0.0),
+            "top_k": int(req["top_k"]) if req.get("top_k") is not None else None,
+            "top_p": float(req["top_p"]) if req.get("top_p") is not None else None,
+            "seed": int(req.get("seed") or 0),
+            "eos_id": int(req["eos_id"]) if req.get("eos_id") is not None else None,
+        }
+        toks = np.asarray(jax.device_get(run(prompt, max_new, sample_kwargs)))
         return {"ok": True, "tokens": toks.tolist(), "n_new": int(toks.shape[-1])}
 
     return HandlerState(invoke_fn=invoke, meta={

@@ -150,21 +150,25 @@ class BundleServer:
                     self._send(503, {"ok": False, "error": "draining"})
                     return
                 t0 = time.monotonic()
+                # in-flight covers the response write too: drain must not
+                # observe 0 (and let the process exit) between handler
+                # completion and the 200 actually reaching the client
                 try:
-                    result = server_self.boot.handler.invoke(
-                        server_self.boot.state, request)
-                except Exception as e:  # handler bug or bad payload shape
-                    server_self.stats.record_error()
-                    log_event(log, "invoke failed", error=str(e),
-                              kind=type(e).__name__)
-                    self._send(500, {"ok": False, "error": str(e),
-                                     "kind": type(e).__name__})
-                    return
+                    try:
+                        result = server_self.boot.handler.invoke(
+                            server_self.boot.state, request)
+                    except Exception as e:  # handler bug or bad payload shape
+                        server_self.stats.record_error()
+                        log_event(log, "invoke failed", error=str(e),
+                                  kind=type(e).__name__)
+                        self._send(500, {"ok": False, "error": str(e),
+                                         "kind": type(e).__name__})
+                        return
+                    server_self.stats.record((time.monotonic() - t0) * 1e3)
+                    self._send(200, result)
                 finally:
                     with server_self._inflight_lock:
                         server_self._inflight -= 1
-                server_self.stats.record((time.monotonic() - t0) * 1e3)
-                self._send(200, result)
 
         return Handler
 

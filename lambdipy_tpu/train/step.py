@@ -53,29 +53,41 @@ def train_shardings(params, mesh: Mesh, rules: ShardingRules, *, fsdp: bool = Tr
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
-def make_train_step(model_apply: Callable, optimizer: optax.GradientTransformation):
+def make_train_step(model_apply: Callable, optimizer: optax.GradientTransformation,
+                    *, model_apply_aux: Callable | None = None,
+                    aux_weight: float = 0.01):
     """Build a jittable (state, tokens) -> (state, metrics) LM train step.
 
     ``model_apply(params, tokens) -> logits``; loss is next-token
-    cross-entropy. The caller jits this with shardings from
+    cross-entropy. For models with an auxiliary loss (MoE router balance),
+    pass ``model_apply_aux(params, tokens) -> (logits, aux)`` and the total
+    loss becomes ``ce + aux_weight * aux`` (so the router actually receives
+    a balance gradient — without it capacity overflow silently drops
+    tokens). The caller jits this with shardings from
     :func:`train_shardings`.
     """
 
     def loss_fn(params, tokens):
-        logits = model_apply(params, tokens[:, :-1])
+        if model_apply_aux is not None:
+            logits, aux = model_apply_aux(params, tokens[:, :-1])
+        else:
+            logits = model_apply(params, tokens[:, :-1])
+            aux = jnp.float32(0.0)
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        ce = jnp.mean(nll)
+        return ce + jnp.float32(aux_weight) * aux, (ce, aux)
 
     def step(state: TrainState, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        (loss, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, tokens)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
         return (
             TrainState(params=params, opt_state=opt_state, step=state.step + 1),
-            {"loss": loss, "grad_norm": gnorm},
+            {"loss": loss, "ce_loss": ce, "aux_loss": aux, "grad_norm": gnorm},
         )
 
     return step
@@ -92,7 +104,8 @@ jax.tree_util.register_dataclass(
 
 def sharded_train_step(model_apply: Callable, params, mesh: Mesh,
                        rules: ShardingRules, *, learning_rate: float = 1e-3,
-                       fsdp: bool = True):
+                       fsdp: bool = True, model_apply_aux: Callable | None = None,
+                       aux_weight: float = 0.01):
     """Convenience: build everything for an SPMD training loop.
 
     Returns (jitted_step, sharded_state, batch_sharding). The batch spec
@@ -100,8 +113,12 @@ def sharded_train_step(model_apply: Callable, params, mesh: Mesh,
     """
     optimizer = optax.adamw(learning_rate)
     p_shardings = train_shardings(params, mesh, rules, fsdp=fsdp)
-    params = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, s), params, p_shardings)
+    # place via a jitted identity, NOT device_put: the step donates state
+    # buffers, and device_put can alias (observed on CPU even with
+    # may_alias=False), which would let that donation delete the caller's
+    # params pytree out from under them; a compiled identity without input
+    # donation must produce fresh output buffers
+    params = jax.jit(lambda p: p, out_shardings=p_shardings)(params)
     state = init_train_state(params, optimizer)
     def _sharding_of(x):
         s = getattr(x, "sharding", None)
@@ -110,7 +127,8 @@ def sharded_train_step(model_apply: Callable, params, mesh: Mesh,
 
     state_shardings = jax.tree_util.tree_map(_sharding_of, state)
     batch_sharding = NamedSharding(mesh, _filter_spec(P("dp", "sp"), mesh, 2))
-    step = make_train_step(model_apply, optimizer)
+    step = make_train_step(model_apply, optimizer,
+                           model_apply_aux=model_apply_aux, aux_weight=aux_weight)
     jitted = jax.jit(step,
                      in_shardings=(state_shardings, batch_sharding),
                      out_shardings=(state_shardings, NamedSharding(mesh, P())),

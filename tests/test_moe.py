@@ -1,0 +1,130 @@
+"""MoE routing/dispatch correctness and expert parallelism on the virtual
+mesh (SURVEY.md §5.4 pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lambdipy_tpu.models.moe import MoEMLP, moe_aux_loss, route_topk
+from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+from lambdipy_tpu.parallel.sharding import shard_params
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=shape), jnp.float32)
+
+
+def test_route_topk_conserves_gates():
+    """With ample capacity every token is fully seated: combine weights sum
+    to 1 per token and dispatch matches the top-k choice count."""
+    t, e, k = 32, 4, 2
+    probs = jax.nn.softmax(_rand((t, e), 0), axis=-1)
+    dispatch, combine, aux = route_topk(probs, k, capacity=t)
+    np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))),
+                               np.ones(t), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dispatch.sum()), t * k)
+    # each (expert, slot) seats at most one token
+    assert np.asarray(dispatch.sum(axis=0)).max() <= 1.0 + 1e-6
+    assert np.isfinite(float(aux))
+
+
+def test_route_topk_drops_overflow():
+    """Capacity 1 on a routing where everyone prefers one expert: exactly
+    ``capacity`` tokens seat there; the rest lose that slot."""
+    t, e = 8, 2
+    logits = jnp.stack([jnp.full((t,), 5.0), jnp.zeros((t,))], axis=1)
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, _ = route_topk(probs, 1, capacity=1)
+    assert float(dispatch[:, 0, :].sum()) == 1.0  # one token seated at expert 0
+    assert float(dispatch.sum()) == 1.0
+
+
+def test_moe_single_expert_equals_dense_swiglu():
+    """num_experts=1, top_k=1, ample capacity routes every token through
+    the one expert with gate 1.0 — identical to a plain SwiGLU MLP."""
+    from flax import linen as nn
+
+    b, s, h, m = 2, 8, 16, 32
+    x = _rand((b, s, h), 1)
+    module = MoEMLP(num_experts=1, mlp=m, top_k=1, capacity_factor=float(b * s),
+                    dtype=jnp.float32)
+    params = module.init(jax.random.PRNGKey(0), x)
+    out = module.apply(params, x)
+
+    p = params["params"]
+    ref = x.reshape(b * s, h)
+    gate = ref @ p["experts_gate"][0]
+    up = ref @ p["experts_up"][0]
+    ref = ((nn.silu(gate) * up) @ p["experts_down"][0]).reshape(b, s, h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_expert_parallel_matches_single_device(cpu_devices):
+    """ep=4 (+dp=2 tokens) sharded forward == unsharded forward."""
+    b, s, h, m, e = 4, 8, 16, 32, 4
+    x = _rand((b, s, h), 2)
+    module = MoEMLP(num_experts=e, mlp=m, top_k=2, dtype=jnp.float32)
+    params = module.init(jax.random.PRNGKey(1), x)
+    ref = module.apply(params, x)
+
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    from lambdipy_tpu.parallel.sharding import ShardingRules
+
+    rules = ShardingRules(rules=(
+        ("*experts_gate", P("ep", None, None)),
+        ("*experts_up", P("ep", None, None)),
+        ("*experts_down", P("ep", None, None)),
+        ("*router", P()),
+    ))
+    with use_mesh(mesh):
+        sp = shard_params(params, mesh, rules)
+        xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+        out = jax.jit(module.apply)(sp, xs)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_llama_moe_forward_and_aux_loss(cpu_devices):
+    """llama-moe-tiny: logits well-formed; sown aux losses retrievable."""
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-moe-tiny").build()
+    params = adapter.init_params(seed=0)
+    tokens = jnp.asarray(np.random.default_rng(3).integers(0, 500, (2, 12)),
+                         jnp.int32)
+    logits = adapter.forward(params, tokens)
+    assert logits.shape == (2, 12, adapter.config.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+    _, state = adapter.module.apply(params, tokens, mutable=["intermediates"])
+    aux = moe_aux_loss(state["intermediates"])
+    # Switch aux loss is ~1.0 at uniform routing, and >= cv-bound above 0
+    assert 0.0 < float(aux) < 10.0
+
+
+def test_llama_moe_sharded_train_step(cpu_devices):
+    """Full train step over a dp×tp×ep mesh: loss finite, params update."""
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.train.step import sharded_train_step
+
+    adapter = registry.get("llama-moe-tiny").build()
+    params = adapter.init_params(seed=0)
+    assert adapter.forward_with_aux is not None
+    mesh = make_mesh({"dp": 2, "tp": 2, "ep": 2})
+    with use_mesh(mesh):
+        step, state, batch_sharding = sharded_train_step(
+            adapter.forward, params, mesh, adapter.tp_rules,
+            model_apply_aux=adapter.forward_with_aux)
+        tokens = jax.device_put(
+            jnp.asarray(np.random.default_rng(4).integers(0, 500, (4, 16)),
+                        jnp.int32), batch_sharding)
+        state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    # the router balance loss is in the optimized objective, not just sown
+    assert float(metrics["aux_loss"]) > 0.0
+    assert float(metrics["loss"]) == pytest.approx(
+        float(metrics["ce_loss"]) + 0.01 * float(metrics["aux_loss"]), rel=1e-5)
+    assert int(state.step) == 1

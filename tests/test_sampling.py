@@ -1,0 +1,108 @@
+"""Decode sampling: logit filtering, temperature/top-k/top-p generation,
+eos short-circuit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models import registry
+from lambdipy_tpu.models.llama import filter_logits, greedy_generate, sample_generate
+
+
+def test_filter_logits_top_k():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.0]], jnp.float32)
+    out = filter_logits(logits, top_k=2)
+    probs = np.asarray(jax.nn.softmax(out, axis=-1))[0]
+    assert probs[1] > 0 and probs[2] > 0
+    np.testing.assert_allclose(probs[0] + probs[3], 0.0, atol=1e-6)
+
+
+def test_filter_logits_top_p():
+    # probs ~ [0.643, 0.237, 0.087, 0.032] — top_p=0.6 keeps only the head;
+    # top_p=0.7 keeps two (cumulative-before-token rule)
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0]], jnp.float32)
+    kept1 = np.asarray(jax.nn.softmax(filter_logits(logits, top_p=0.6)))[0]
+    assert kept1[0] > 0.999
+    kept2 = np.asarray(jax.nn.softmax(filter_logits(logits, top_p=0.7)))[0]
+    assert kept2[0] > 0 and kept2[1] > 0
+    np.testing.assert_allclose(kept2[2] + kept2[3], 0.0, atol=1e-6)
+
+
+def test_filter_logits_always_keeps_argmax():
+    logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]], jnp.float32)
+    out = filter_logits(logits, top_k=1, top_p=0.01)
+    assert int(jnp.argmax(out)) == 0
+    assert np.isfinite(np.asarray(out)[0, 0])
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    adapter = registry.get("llama-tiny").build()
+    return adapter, adapter.init_params(seed=0)
+
+
+def test_sample_temperature_zero_is_greedy(tiny_llama):
+    adapter, params = tiny_llama
+    prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    ref = greedy_generate(adapter.module, params, prompt, max_new_tokens=6)
+    out = sample_generate(adapter.module, params, prompt,
+                          rng=jax.random.PRNGKey(1), max_new_tokens=6,
+                          temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_sample_deterministic_per_key_and_varies(tiny_llama):
+    adapter, params = tiny_llama
+    prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+
+    def draw(seed):
+        return np.asarray(sample_generate(
+            adapter.module, params, prompt, rng=jax.random.PRNGKey(seed),
+            max_new_tokens=8, temperature=1.5))
+
+    np.testing.assert_array_equal(draw(0), draw(0))
+    draws = [draw(s) for s in range(6)]
+    assert any(not np.array_equal(draws[0], d) for d in draws[1:]), \
+        "6 seeds at temperature 1.5 all produced identical tokens"
+
+
+def test_sample_top_k1_is_greedy(tiny_llama):
+    """top_k=1 collapses the categorical to argmax at any temperature."""
+    adapter, params = tiny_llama
+    prompt = jnp.asarray([[9, 10, 11]], jnp.int32)
+    ref = greedy_generate(adapter.module, params, prompt, max_new_tokens=5)
+    out = sample_generate(adapter.module, params, prompt,
+                          rng=jax.random.PRNGKey(3), max_new_tokens=5,
+                          temperature=2.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_eos_short_circuit(tiny_llama):
+    """Once eos appears, the remainder of the row is eos."""
+    adapter, params = tiny_llama
+    prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    free = np.asarray(greedy_generate(adapter.module, params, prompt,
+                                      max_new_tokens=8))[0]
+    eos = int(free[2])  # force the 3rd emitted token to be "eos"
+    out = np.asarray(greedy_generate(adapter.module, params, prompt,
+                                     max_new_tokens=8, eos_id=eos))[0]
+    np.testing.assert_array_equal(out[:3], free[:3])
+    assert (out[np.where(out == eos)[0][0]:] == eos).all()
+
+
+def test_registry_generate_routes_sampling(tiny_llama):
+    adapter, params = tiny_llama
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    greedy = adapter.generate(params, prompt, max_new_tokens=4)
+    sampled = adapter.generate(params, prompt, max_new_tokens=4,
+                               temperature=1.0, top_k=8, seed=7)
+    assert np.asarray(greedy).shape == np.asarray(sampled).shape == (1, 4)
+
+
+def test_filter_logits_top_p_zero_degrades_to_greedy():
+    """top_p <= 0 keeps (only) the argmax instead of masking everything."""
+    logits = jnp.asarray([[10.0, 0.0, -1.0, -2.0]], jnp.float32)
+    out = np.asarray(filter_logits(logits, top_p=0.0))[0]
+    assert out[0] == 10.0
+    assert (out[1:] < -1e29).all()
