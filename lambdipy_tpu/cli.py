@@ -10,6 +10,7 @@ table, publish/deploy row). End state per BASELINE.json:
 from __future__ import annotations
 
 import json
+import math
 import sys
 import tempfile
 from pathlib import Path
@@ -428,6 +429,49 @@ def deployments_cmd():
 
     for dep in LocalRuntime().list():
         click.echo(f"{dep.name:25s} pid={dep.pid:<8d} {dep.url}")
+
+
+@main.command("bench")
+@click.argument("name")
+@click.option("--data", default='{"random": true}', help="JSON request body")
+@click.option("-n", "iters", type=int, default=50, help="measured invokes")
+@click.option("--warmup", type=int, default=5)
+def bench_cmd(name, data, iters, warmup):
+    """Measure invoke latency percentiles against a deployment."""
+    import statistics
+    import time as _time
+
+    from lambdipy_tpu.runtime.deploy import DeployError, LocalRuntime
+
+    try:
+        request = json.loads(data)
+    except json.JSONDecodeError as e:
+        raise click.ClickException(f"--data is not valid JSON: {e}") from e
+    rt = LocalRuntime()
+    try:
+        for _ in range(warmup):
+            rt.invoke(name, request)
+        times = []
+        for _ in range(iters):
+            t0 = _time.monotonic()
+            out = rt.invoke(name, request)
+            times.append((_time.monotonic() - t0) * 1000.0)
+            if not out.get("ok", True):
+                raise click.ClickException(f"invoke failed: {out}")
+    except DeployError as e:
+        raise click.ClickException(str(e)) from e
+    times.sort()
+
+    def pct(q):  # nearest-rank percentile: ceil(q*n) - 1, 0-based
+        return times[max(0, math.ceil(q * iters) - 1)]
+
+    click.echo(json.dumps({
+        "name": name, "n": iters,
+        "p50_ms": round(statistics.median(times), 3),
+        "p90_ms": round(pct(0.90), 3),
+        "p99_ms": round(pct(0.99), 3),
+        "mean_ms": round(statistics.fmean(times), 3),
+    }))
 
 
 @main.command("stop")

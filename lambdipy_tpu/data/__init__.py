@@ -1,0 +1,12 @@
+"""Input pipeline: deterministic, resumable, multi-host-sharded batching.
+
+The reference has no data loading (it packages code, not data); this is
+new surface modeled on the grain pattern from the canonical TPU stack
+(SURVEY.md §3.4 — jss:tpu/Dockerfile installs grain): index-based access,
+a seeded per-epoch permutation, and a tiny restorable state, so a resumed
+training run replays the exact batch sequence it would have seen.
+"""
+
+from lambdipy_tpu.data.loader import ShardedLoader, TokenSource
+
+__all__ = ["ShardedLoader", "TokenSource"]

@@ -1,0 +1,173 @@
+"""Deterministic sharded data loading for LM training.
+
+Design (grain-style, TPU-first):
+
+- **Index-based, not stream-based**: an epoch is a seeded permutation of
+  example indices; batch ``t`` is a pure function of ``(seed, epoch, t)``.
+  That makes the loader trivially resumable — its entire state is three
+  integers — and keeps host work off the device critical path.
+- **Multi-host sharding**: each process reads only its
+  ``global_batch / process_count`` slice of every batch
+  (parallel/distributed.py process_batch_slice contract); jax assembles
+  the global array from per-process shards via the dp/sp batch sharding.
+- **Static shapes**: fixed ``[batch, seq_len+1]`` windows (the +1 feeds
+  the shift-by-one LM objective in train/step.py), partial tail windows
+  dropped — no dynamic shapes under jit, ever.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+
+class TokenSource:
+    """A flat token array exposed as fixed-length example windows.
+
+    Accepts an in-memory array or a ``.npy`` / raw binary file (memory-
+    mapped, so multi-GB corpora don't load into RAM). Window n is
+    ``tokens[n*stride : n*stride + seq_len + 1]``; stride defaults to
+    ``seq_len`` (disjoint windows, +1 overlap for the LM target shift).
+    """
+
+    def __init__(self, tokens, seq_len: int, *, stride: int | None = None,
+                 dtype=np.int32):
+        if isinstance(tokens, (str, Path)):
+            path = Path(tokens)
+            if path.suffix == ".npy":
+                self.tokens = np.load(path, mmap_mode="r")
+            else:
+                self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        else:
+            self.tokens = np.asarray(tokens)
+        if self.tokens.ndim != 1:
+            raise ValueError(f"token source must be 1-D, got {self.tokens.shape}")
+        self.seq_len = int(seq_len)
+        self.stride = int(stride or seq_len)
+        window = self.seq_len + 1
+        n = (len(self.tokens) - window) // self.stride + 1
+        if n <= 0:
+            raise ValueError(
+                f"{len(self.tokens)} tokens < one window of {window}")
+        self.num_examples = n
+
+    def __len__(self) -> int:
+        return self.num_examples
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        start = int(idx) * self.stride
+        return np.asarray(self.tokens[start:start + self.seq_len + 1],
+                          dtype=np.int32)
+
+
+@dataclass
+class LoaderState:
+    """The complete resume state — three integers (plus the seed)."""
+
+    seed: int
+    epoch: int
+    step_in_epoch: int
+
+    def as_dict(self) -> dict:
+        return {"seed": self.seed, "epoch": self.epoch,
+                "step_in_epoch": self.step_in_epoch}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LoaderState":
+        return cls(seed=int(d["seed"]), epoch=int(d["epoch"]),
+                   step_in_epoch=int(d["step_in_epoch"]))
+
+
+class ShardedLoader:
+    """Deterministic epoch-shuffled batches, sharded across processes.
+
+    ``next_batch()`` returns this process's ``[local_batch, seq_len+1]``
+    int32 slice of the global batch; :meth:`place` puts it on the mesh with
+    the dp/sp sharding so jit sees one global array (multi-host: every
+    process places its own shard, jax stitches them).
+    """
+
+    def __init__(self, source: TokenSource, global_batch: int, *,
+                 seed: int = 0, shuffle: bool = True,
+                 process_index: int | None = None,
+                 process_count: int | None = None):
+        import jax
+
+        from lambdipy_tpu.parallel.distributed import process_batch_slice
+
+        self.source = source
+        self.global_batch = int(global_batch)
+        self.shuffle = shuffle
+        self._pc = process_count if process_count is not None else jax.process_count()
+        self._pi = process_index if process_index is not None else jax.process_index()
+        # the single source of truth for multi-host slicing
+        self.local_batch, self._offset = process_batch_slice(
+            self.global_batch, process_index=self._pi, process_count=self._pc)
+        if len(source) < self.global_batch:
+            raise ValueError(
+                f"{len(source)} examples < one global batch of {global_batch}")
+        self.state = LoaderState(seed=int(seed), epoch=0, step_in_epoch=0)
+        self._perm_epoch: int | None = None
+        self._perm: np.ndarray | None = None
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return len(self.source) // self.global_batch  # partial tail dropped
+
+    def _permutation(self, epoch: int) -> np.ndarray:
+        if self._perm_epoch != epoch:
+            if self.shuffle:
+                rng = np.random.default_rng((self.state.seed, epoch))
+                self._perm = rng.permutation(len(self.source))
+            else:
+                self._perm = np.arange(len(self.source))
+            self._perm_epoch = epoch
+        return self._perm
+
+    def next_batch(self) -> np.ndarray:
+        """This process's shard of the next global batch (advances state)."""
+        st = self.state
+        if st.step_in_epoch >= self.steps_per_epoch:
+            st.epoch += 1
+            st.step_in_epoch = 0
+        perm = self._permutation(st.epoch)
+        base = st.step_in_epoch * self.global_batch + self._offset
+        idxs = perm[base:base + self.local_batch]
+        st.step_in_epoch += 1
+        return np.stack([self.source[i] for i in idxs])
+
+    def place(self, batch: np.ndarray, mesh, batch_sharding=None):
+        """Device-put a host shard as (its slice of) the global sharded
+        batch. With an explicit ``batch_sharding`` (from
+        sharded_train_step) multi-host assembly goes through
+        ``make_array_from_process_local_data``; without one it falls back
+        to the dp/sp spec."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from lambdipy_tpu.parallel.sharding import _filter_spec
+
+        if batch_sharding is None:
+            # shard sequence over sp only when the (seq_len+1) window
+            # divides evenly; otherwise keep it replicated on that axis
+            sp_ok = (batch.ndim > 1 and "sp" in mesh.axis_names
+                     and batch.shape[1] % mesh.shape["sp"] == 0)
+            spec = P("dp", "sp") if sp_ok else P("dp")
+            batch_sharding = NamedSharding(
+                mesh, _filter_spec(spec, mesh, batch.ndim))
+        if self._pc == 1:
+            return jax.device_put(batch, batch_sharding)
+        global_shape = (batch.shape[0] * self._pc,) + batch.shape[1:]
+        return jax.make_array_from_process_local_data(
+            batch_sharding, batch, global_shape)
+
+    # -- resume -------------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return self.state.as_dict()
+
+    def restore(self, state: dict) -> None:
+        self.state = LoaderState.from_dict(state)
+        self._perm_epoch = None  # force re-derivation from (seed, epoch)

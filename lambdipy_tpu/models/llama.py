@@ -48,6 +48,10 @@ class LlamaConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # int8 matmul backend: "xla" (dequant fused by XLA, works under TP
+    # sharding) or "pallas" (ops/quant.py blocked kernel — single-chip
+    # serving; falls back per-matmul when shapes don't tile).
+    matmul_backend: str = "xla"
 
     @property
     def head_dim(self) -> int:
@@ -83,6 +87,7 @@ class QDense(nn.Module):
     features: int
     quant: str | None = None
     dtype: Any = jnp.bfloat16
+    backend: str = "xla"  # "xla" | "pallas" (int8 only, unsharded)
 
     @nn.compact
     def __call__(self, x):
@@ -100,6 +105,16 @@ class QDense(nn.Module):
             scale = self.param(
                 "scale", nn.initializers.constant(1.0 / (127.0 * in_features ** 0.5)),
                 (1, self.features), jnp.float32)
+            if self.backend == "pallas":
+                from lambdipy_tpu.ops.quant import int8_matmul
+                from lambdipy_tpu.parallel.mesh import current_mesh
+
+                # the blocked kernel is a manual (unpartitioned) op: only
+                # take it when no mesh is ambient (single-chip serving)
+                if current_mesh() is None:
+                    flat = x.astype(self.dtype).reshape(-1, in_features)
+                    out = int8_matmul(flat, w_i8, scale)
+                    return out.reshape(*x.shape[:-1], self.features)
             w = w_i8.astype(self.dtype) * scale.astype(self.dtype)
         else:
             w = self.param("kernel", nn.initializers.lecun_normal(),
@@ -172,9 +187,9 @@ class LlamaBlock(nn.Module):
         d = cfg.head_dim
         h = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
         b, s, _ = h.shape
-        q = QDense(cfg.heads * d, cfg.quant, cfg.dtype, name="q_proj")(h)
-        k = QDense(cfg.kv_heads * d, cfg.quant, cfg.dtype, name="k_proj")(h)
-        v = QDense(cfg.kv_heads * d, cfg.quant, cfg.dtype, name="v_proj")(h)
+        q = QDense(cfg.heads * d, cfg.quant, cfg.dtype, cfg.matmul_backend, name="q_proj")(h)
+        k = QDense(cfg.kv_heads * d, cfg.quant, cfg.dtype, cfg.matmul_backend, name="k_proj")(h)
+        v = QDense(cfg.kv_heads * d, cfg.quant, cfg.dtype, cfg.matmul_backend, name="v_proj")(h)
         q = q.reshape(b, s, cfg.heads, d)
         k = k.reshape(b, s, cfg.kv_heads, d)
         v = v.reshape(b, s, cfg.kv_heads, d)
@@ -195,18 +210,19 @@ class LlamaBlock(nn.Module):
             new_cache = {"k": ck, "v": cv}
 
         out = out.reshape(b, s, cfg.heads * d)
-        x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, name="o_proj")(out)
+        x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, cfg.matmul_backend, name="o_proj")(out)
 
         h = RMSNorm(cfg.norm_eps, name="mlp_norm")(x)
         if cfg.moe_experts:
             from lambdipy_tpu.models.moe import MoEMLP
 
             x = x + MoEMLP(cfg.moe_experts, cfg.mlp, cfg.moe_top_k,
-                           cfg.moe_capacity_factor, cfg.dtype, name="moe")(h)
+                           cfg.moe_capacity_factor, cfg.dtype, cfg.quant,
+                           name="moe")(h)
         else:
-            gate = QDense(cfg.mlp, cfg.quant, cfg.dtype, name="gate_proj")(h)
-            up = QDense(cfg.mlp, cfg.quant, cfg.dtype, name="up_proj")(h)
-            x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, name="down_proj")(
+            gate = QDense(cfg.mlp, cfg.quant, cfg.dtype, cfg.matmul_backend, name="gate_proj")(h)
+            up = QDense(cfg.mlp, cfg.quant, cfg.dtype, cfg.matmul_backend, name="up_proj")(h)
+            x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, cfg.matmul_backend, name="down_proj")(
                 nn.silu(gate) * up)
         return x, new_cache
 
@@ -236,7 +252,7 @@ class LlamaModel(nn.Module):
             x, c = LlamaBlock(cfg, name=f"layer_{i}")(x, positions, mask, layer_cache)
             new_cache.append(c)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
-        logits = QDense(cfg.vocab_size, cfg.quant, jnp.float32, name="lm_head")(x)
+        logits = QDense(cfg.vocab_size, cfg.quant, jnp.float32, cfg.matmul_backend, name="lm_head")(x)
         return logits, new_cache
 
 
@@ -266,10 +282,15 @@ def prefill_into_cache(cfg: LlamaConfig, prefill_cache, batch: int, max_len: int
     return out
 
 
+_MOE_EXPERT_KEYS = ("experts_gate", "experts_up", "experts_down")
+
+
 def quantize_params(float_params):
     """Convert a float LlamaModel params pytree (quant=None) into the int8
     layout (quant="int8"): each QDense ``kernel`` becomes ``kernel_int8`` +
-    per-output-channel ``scale``. Embeddings and norms stay float."""
+    per-output-channel ``scale``, and each 3-D MoE expert stack becomes
+    ``<name>_int8`` + per-(expert, channel) ``<name>_scale``. Embeddings,
+    norms and the router stay float."""
 
     def convert(tree):
         if isinstance(tree, dict):
@@ -282,6 +303,19 @@ def quantize_params(float_params):
                 out["kernel_int8"] = jnp.round(w / scale).astype(jnp.int8)
                 out["scale"] = scale
                 return out
+            if any(k in tree and getattr(tree[k], "ndim", 0) == 3
+                   for k in _MOE_EXPERT_KEYS):
+                out = dict(tree)
+                for k in _MOE_EXPERT_KEYS:
+                    if k in out and getattr(out[k], "ndim", 0) == 3:
+                        w = jnp.asarray(out[k], jnp.float32)  # [e, in, out]
+                        scale = jnp.max(jnp.abs(w), axis=1, keepdims=True) / 127.0
+                        scale = jnp.maximum(scale, 1e-8)
+                        del out[k]
+                        out[f"{k}_int8"] = jnp.round(w / scale).astype(jnp.int8)
+                        out[f"{k}_scale"] = scale
+                return {k: convert(v) if isinstance(v, dict) else v
+                        for k, v in out.items()}
             return {k: convert(v) for k, v in tree.items()}
         return tree
 
@@ -339,7 +373,7 @@ def pipeline_forward(model: LlamaModel, params, tokens, mesh, *,
         stage_fn, stacked, split_microbatches(x, num_microbatches), mesh,
         const=const))
     x = RMSNorm(cfg.norm_eps).apply({"params": p["final_norm"]}, x)
-    return QDense(cfg.vocab_size, cfg.quant, jnp.float32).apply(
+    return QDense(cfg.vocab_size, cfg.quant, jnp.float32, cfg.matmul_backend).apply(
         {"params": p["lm_head"]}, x)
 
 

@@ -81,13 +81,38 @@ def route_topk(probs, top_k: int, capacity: int):
 
 
 class MoEMLP(nn.Module):
-    """Top-k routed SwiGLU experts, expert dim sharded over ``ep``."""
+    """Top-k routed SwiGLU experts, expert dim sharded over ``ep``.
+
+    ``quant="int8"``: expert weights stored int8 with per-(expert, output-
+    channel) fp32 scales — the experts are the dominant parameters of an
+    MoE model, so they must join the 1-byte/param HBM budget that int8
+    serving relies on (same scheme as llama.py QDense; real weights come
+    through llama.quantize_params which handles the 3-D expert stacks).
+    """
 
     num_experts: int
     mlp: int
     top_k: int = 2
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
+    quant: str | None = None
+
+    def _expert_weight(self, name: str, shape):
+        if self.quant == "int8":
+            def init_int8(key, shape, _dtype):
+                w = nn.initializers.lecun_normal(batch_axis=(0,))(
+                    key, shape, jnp.float32)
+                scale = jnp.max(jnp.abs(w), axis=1, keepdims=True) / 127.0
+                return jnp.round(w / jnp.maximum(scale, 1e-8)).astype(jnp.int8)
+
+            w_i8 = self.param(f"{name}_int8", init_int8, shape, jnp.int8)
+            scale = self.param(
+                f"{name}_scale",
+                nn.initializers.constant(1.0 / (127.0 * shape[1] ** 0.5)),
+                (shape[0], 1, shape[2]), jnp.float32)
+            return w_i8.astype(self.dtype) * scale.astype(self.dtype)
+        return self.param(name, nn.initializers.lecun_normal(batch_axis=(0,)),
+                          shape, self.dtype)
 
     @nn.compact
     def __call__(self, x):
@@ -103,10 +128,9 @@ class MoEMLP(nn.Module):
         dispatch, combine, aux = route_topk(probs, self.top_k, capacity)
         self.sow("intermediates", "moe_aux_loss", aux)
 
-        init = nn.initializers.lecun_normal(batch_axis=(0,))
-        w_gate = self.param("experts_gate", init, (e, hidden, m), self.dtype)
-        w_up = self.param("experts_up", init, (e, hidden, m), self.dtype)
-        w_down = self.param("experts_down", init, (e, m, hidden), self.dtype)
+        w_gate = self._expert_weight("experts_gate", (e, hidden, m))
+        w_up = self._expert_weight("experts_up", (e, hidden, m))
+        w_down = self._expert_weight("experts_down", (e, m, hidden))
 
         # dispatch all-to-all: tokens (dp-sharded) -> expert shards (ep)
         xe = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),

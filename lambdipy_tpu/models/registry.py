@@ -211,9 +211,13 @@ def _llama_tp_rules():
         ("*lm_head/kernel*", P(None, "tp")),
         ("*lm_head/scale", P(None, "tp")),
         # MoE experts: expert dim over ep, expert-hidden over tp; router
-        # replicated (tiny, fp32, routing must agree across shards)
-        ("*moe/experts_gate", P("ep", None, "tp")),
-        ("*moe/experts_up", P("ep", None, "tp")),
+        # replicated (tiny, fp32, routing must agree across shards).
+        # Trailing * covers the int8 layout (_int8 stacks and _scale
+        # tensors shard like their float originals; scale dim 1 is size 1)
+        ("*moe/experts_gate*", P("ep", None, "tp")),
+        ("*moe/experts_up*", P("ep", None, "tp")),
+        ("*moe/experts_down_int8", P("ep", "tp", None)),
+        ("*moe/experts_down_scale", P("ep", None, None)),
         ("*moe/experts_down", P("ep", "tp", None)),
         ("*moe/router", P()),
     ))

@@ -133,11 +133,15 @@ def make_hybrid_mesh(ici: dict[str, int], dcn: dict[str, int] | None = None,
     return Mesh(arr, axis_names=tuple(axes))
 
 
-def process_batch_slice(global_batch: int) -> tuple[int, int]:
+def process_batch_slice(global_batch: int, *, process_index: int | None = None,
+                        process_count: int | None = None) -> tuple[int, int]:
     """(local_batch, offset) for this process's equal share of a global
-    batch — the data-loading contract for multi-host input pipelines."""
-    n = jax.process_count()
+    batch — THE data-loading contract for multi-host input pipelines
+    (data/loader.py derives its shards from this). Overrides exist for
+    tests and explicit launchers; defaults read the jax runtime."""
+    n = process_count if process_count is not None else jax.process_count()
+    i = process_index if process_index is not None else jax.process_index()
     if global_batch % n:
         raise ValueError(f"global batch {global_batch} not divisible by {n} processes")
     local = global_batch // n
-    return local, local * jax.process_index()
+    return local, local * i

@@ -71,9 +71,10 @@ def test_hybrid_mesh_runs_collectives(cpu_devices):
 def test_process_batch_slice():
     local, offset = process_batch_slice(32)
     assert (local, offset) == (32, 0)
+    # explicit multi-process overrides exercise the slicing + the guard
+    assert process_batch_slice(32, process_index=3, process_count=4) == (8, 24)
     with pytest.raises(ValueError):
-        process_batch_slice(33) if jax.process_count() > 1 else (_ for _ in ()).throw(
-            ValueError("single-process: any batch divides"))
+        process_batch_slice(33, process_index=0, process_count=2)
 
 
 def test_train_checkpoint_resume(tmp_path, cpu_devices):

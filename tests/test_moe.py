@@ -128,3 +128,31 @@ def test_llama_moe_sharded_train_step(cpu_devices):
     assert float(metrics["loss"]) == pytest.approx(
         float(metrics["ce_loss"]) + 0.01 * float(metrics["aux_loss"]), rel=1e-5)
     assert int(state.step) == 1
+
+
+def test_moe_int8_quantization_roundtrip(cpu_devices):
+    """quantize_params converts the 3-D expert stacks; the int8 module
+    reproduces the float forward within quantization error."""
+    import dataclasses
+
+    from lambdipy_tpu.models.llama import LLAMA_TINY, LlamaModel, quantize_params
+
+    cfg = dataclasses.replace(LLAMA_TINY, moe_experts=4, moe_top_k=2)
+    module = LlamaModel(cfg)
+    tokens = jnp.asarray(np.random.default_rng(8).integers(0, 500, (2, 12)),
+                         jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tokens)
+    ref, _ = module.apply(params, tokens)
+
+    qparams = quantize_params(params)
+    flat = {"/".join(str(getattr(k, "key", k)) for k in path): v.shape
+            for path, v in jax.tree_util.tree_leaves_with_path(qparams)}
+    assert any("experts_gate_int8" in k for k in flat), sorted(flat)[:8]
+    assert not any(k.endswith("experts_gate") for k in flat)
+
+    qmodule = LlamaModel(dataclasses.replace(cfg, quant="int8"))
+    out, _ = qmodule.apply(qparams, tokens)
+    # int8 weight-only quantization error on logits, not exactness
+    err = float(jnp.mean(jnp.abs(out - ref)))
+    ref_mag = float(jnp.mean(jnp.abs(ref)))
+    assert err < 0.1 * ref_mag, (err, ref_mag)
