@@ -67,6 +67,17 @@ def materialize_payload(recipe: Recipe, bundle_dir: Path) -> dict:
         "extra": dict(payload.extra),
         "device": recipe.device,
     }
+    # a tokenizer named by the recipe is COPIED into the bundle (bundles
+    # deploy on machines where the build-host path doesn't exist) and the
+    # spec rewritten bundle-relative BEFORE it's baked into handler.py
+    tok_path = spec["extra"].get("tokenizer_path")
+    if tok_path:
+        src = Path(tok_path)
+        if not src.is_dir():
+            raise ValueError(
+                f"recipe {recipe.name}: tokenizer_path {tok_path!r} is not a directory")
+        copy_tree(src, Path(bundle_dir) / "tokenizer")
+        spec["extra"]["tokenizer_path"] = "tokenizer"
     handler_py = _HANDLER_TEMPLATE.format(
         recipe=recipe.name, module=module, attr=attr, spec=spec)
     (Path(bundle_dir) / "handler.py").write_text(handler_py)
@@ -79,6 +90,19 @@ def materialize_payload(recipe: Recipe, bundle_dir: Path) -> dict:
         info = model_registry.save_init_params(
             payload.model, params_dir, dtype=payload.dtype, quant=payload.quant,
             extra=dict(payload.extra))
+        manifest_payload["params"] = "params"
+        manifest_payload["params_info"] = info
+    elif payload.params == "hf":
+        # real weights: convert a local HuggingFace checkpoint
+        # (payload.extra hf_path) into the bundle's orbax params
+        from lambdipy_tpu.models.convert import save_hf_params
+
+        hf_path = dict(payload.extra or ()).get("hf_path")
+        if not hf_path:
+            raise ValueError(
+                f"recipe {recipe.name}: params='hf' needs [payload.extra] hf_path")
+        info = save_hf_params(hf_path, Path(bundle_dir) / "params",
+                              quant=payload.quant)
         manifest_payload["params"] = "params"
         manifest_payload["params_info"] = info
     return manifest_payload

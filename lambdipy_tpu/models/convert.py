@@ -1,0 +1,171 @@
+"""HuggingFace weight import: transformers checkpoints -> framework params.
+
+The migration path for users arriving with real weights: a local HF Llama
+checkpoint (or in-memory ``LlamaForCausalLM``) converts into the exact
+pytree models/llama.py expects, verified to logits parity in
+tests/test_convert.py. Conversion happens on host numpy (no device memory
+spike); quantization (llama.quantize_params) and sharding happen after, on
+the target mesh.
+
+Mapping notes (HF ``modeling_llama`` naming):
+- torch ``nn.Linear`` stores ``weight`` as [out, in] -> transposed into
+  our [in, out] kernels;
+- HF rotary embeddings use the rotate-half convention, same as llama.rope
+  (split halves, not interleaved pairs) — weights port without permutation;
+- ``tie_word_embeddings``: the lm_head kernel falls back to the transposed
+  embedding matrix.
+
+Offline rule (SURVEY.md §8, no network): sources are local paths or
+already-constructed models only; nothing here downloads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+from lambdipy_tpu.utils.logs import get_logger, log_event
+
+log = get_logger("lambdipy.convert")
+
+
+def _to_numpy(t) -> np.ndarray:
+    """Torch/array -> numpy, preserving the checkpoint dtype: an 8B bf16
+    checkpoint must not silently double into fp32 orbax params. The fp32
+    hop is exact for bf16/f16 (strict supersets), so round-tripping back
+    to the source dtype loses nothing."""
+    if hasattr(t, "detach"):  # torch tensor
+        orig = str(t.dtype).replace("torch.", "")
+        arr = t.detach().to("cpu").float().numpy()
+        if orig == "bfloat16":
+            import ml_dtypes
+
+            return arr.astype(ml_dtypes.bfloat16)
+        if orig == "float16":
+            return arr.astype(np.float16)
+        return arr
+    return np.asarray(t)
+
+
+def _state_dict_of(source) -> tuple[dict, dict | None]:
+    """(state_dict, hf_config_dict|None) from a model / path / mapping."""
+    if hasattr(source, "state_dict") and hasattr(source, "config"):
+        return dict(source.state_dict()), source.config.to_dict()
+    if isinstance(source, (str, Path)):
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            str(source), local_files_only=True)
+        return dict(model.state_dict()), model.config.to_dict()
+    return dict(source), None
+
+
+def llama_config_from_hf(hf_cfg: dict, **overrides):
+    """Map an HF LlamaConfig dict onto our LlamaConfig."""
+    from lambdipy_tpu.models.llama import LlamaConfig
+
+    import jax.numpy as jnp
+
+    cfg = LlamaConfig(
+        vocab_size=int(hf_cfg["vocab_size"]),
+        hidden=int(hf_cfg["hidden_size"]),
+        layers=int(hf_cfg["num_hidden_layers"]),
+        heads=int(hf_cfg["num_attention_heads"]),
+        kv_heads=int(hf_cfg.get("num_key_value_heads",
+                                hf_cfg["num_attention_heads"])),
+        mlp=int(hf_cfg["intermediate_size"]),
+        max_len=int(hf_cfg.get("max_position_embeddings", 8192)),
+        rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+        norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
+        dtype=jnp.bfloat16,
+    )
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def import_hf_llama(source, *, config_overrides: dict | None = None):
+    """Convert an HF Llama checkpoint into (LlamaConfig, params).
+
+    ``source``: a ``transformers`` model instance, a local checkpoint path,
+    or a raw ``state_dict`` mapping (then pass the architecture via
+    ``config_overrides`` on a LlamaConfig-complete dict).
+    """
+    sd, hf_cfg = _state_dict_of(source)
+    sd = {k: _to_numpy(v) for k, v in sd.items()}
+    if hf_cfg is None:
+        raise ValueError(
+            "raw state_dict needs an HF config; pass a model or path instead")
+    cfg = llama_config_from_hf(hf_cfg, **(config_overrides or {}))
+
+    def lin(name):  # torch Linear [out, in] -> kernel [in, out]
+        return {"kernel": np.ascontiguousarray(sd[f"{name}.weight"].T)}
+
+    def norm(name):
+        return {"scale": sd[f"{name}.weight"]}
+
+    params: dict = {
+        "embed": {"embedding": sd["model.embed_tokens.weight"]},
+        "final_norm": norm("model.norm"),
+    }
+    for i in range(cfg.layers):
+        hf = f"model.layers.{i}"
+        params[f"layer_{i}"] = {
+            "attn_norm": norm(f"{hf}.input_layernorm"),
+            "q_proj": lin(f"{hf}.self_attn.q_proj"),
+            "k_proj": lin(f"{hf}.self_attn.k_proj"),
+            "v_proj": lin(f"{hf}.self_attn.v_proj"),
+            "o_proj": lin(f"{hf}.self_attn.o_proj"),
+            "mlp_norm": norm(f"{hf}.post_attention_layernorm"),
+            "gate_proj": lin(f"{hf}.mlp.gate_proj"),
+            "up_proj": lin(f"{hf}.mlp.up_proj"),
+            "down_proj": lin(f"{hf}.mlp.down_proj"),
+        }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": np.ascontiguousarray(sd["lm_head.weight"].T)}
+    else:  # tie_word_embeddings
+        params["lm_head"] = {
+            "kernel": np.ascontiguousarray(sd["model.embed_tokens.weight"].T)}
+    n = sum(v.size for v in jax_tree_leaves(params))
+    log_event(log, "hf llama imported", layers=cfg.layers, n_params=int(n))
+    return cfg, {"params": params}
+
+
+def jax_tree_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def save_hf_params(hf_path: str | Path, params_dir: Path, *,
+                   quant: str | None = None) -> dict:
+    """Bundle-build hook: convert a local HF Llama checkpoint and persist
+    it as the bundle's orbax params (bundle/package.py params="hf")."""
+    from lambdipy_tpu.utils.platform import prefer_cpu_backend
+
+    prefer_cpu_backend()  # host-side conversion; leave the TPU to the warmer
+    import jax
+    import orbax.checkpoint as ocp
+
+    from lambdipy_tpu.models.llama import quantize_params
+
+    cfg, params = import_hf_llama(hf_path)
+    if quant == "int8":
+        params = jax.device_get(quantize_params(params))
+    params_dir = Path(params_dir)
+    params_dir.mkdir(parents=True, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save((params_dir / "orbax").resolve(), params)
+    ckptr.wait_until_finished()
+    n = sum(v.size for v in jax_tree_leaves(params))
+    info = {"format": "orbax", "n_params": int(n), "source": "hf",
+            "hf_path": str(hf_path), "quant": quant,
+            # the COMPLETE architecture: the serve side rebuilds the module
+            # from exactly this dict, so every field that changes numerics
+            # or limits (norm_eps! max_len!) must be here, not defaulted
+            "config": {"vocab_size": cfg.vocab_size, "hidden": cfg.hidden,
+                       "layers": cfg.layers, "heads": cfg.heads,
+                       "kv_heads": cfg.kv_heads, "mlp": cfg.mlp,
+                       "rope_theta": cfg.rope_theta,
+                       "norm_eps": cfg.norm_eps, "max_len": cfg.max_len}}
+    return info

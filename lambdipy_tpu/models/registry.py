@@ -280,6 +280,23 @@ def _build_llama3_8b(dtype: str = "bfloat16", quant: str | None = "int8",
     return _build_llama(cfg)
 
 
+@register("llama-hf", "jax", "Llama with architecture from an imported HF checkpoint")
+def _build_llama_hf(dtype: str = "bfloat16", quant: str | None = None,
+                    extra: dict | None = None) -> JaxModel:
+    """Serve an HF-imported checkpoint: every architecture field comes from
+    ``extra`` (recorded in the bundle manifest by models/convert.py), so
+    the module exactly matches the converted weights."""
+    import dataclasses
+
+    from lambdipy_tpu.models.llama import LlamaConfig
+
+    extra = extra or {}
+    fields = {f.name for f in dataclasses.fields(LlamaConfig)}
+    cfg = LlamaConfig(dtype=_dtype(dtype), quant=quant, **{
+        k: v for k, v in extra.items() if k in fields - {"dtype", "quant"}})
+    return _build_llama(cfg)
+
+
 @register("llama-moe-tiny", "jax", "tiny MoE Llama (expert-parallel tests/dry-runs)")
 def _build_llama_moe_tiny(dtype: str = "float32", quant: str | None = None,
                           extra: dict | None = None) -> JaxModel:
@@ -368,6 +385,11 @@ def save_init_params(model: str, params_dir: Path, *, dtype: str = "bfloat16",
     params_dir = Path(params_dir)
     params_dir.mkdir(parents=True, exist_ok=True)
     if spec.kind == "jax":
+        from lambdipy_tpu.utils.platform import prefer_cpu_backend
+
+        # init math doesn't need the device, and holding the TPU here
+        # starves the builder's warm subprocess (the step that must own it)
+        prefer_cpu_backend()
         import jax
         import orbax.checkpoint as ocp
 

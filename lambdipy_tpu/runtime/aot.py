@@ -26,7 +26,9 @@ one level down, at the XLA-program level.
 from __future__ import annotations
 
 import json
+import os
 import pickle
+import time
 from pathlib import Path
 from typing import Any, Callable, Sequence
 
@@ -36,6 +38,13 @@ from lambdipy_tpu.utils.logs import get_logger
 log = get_logger("lambdipy.aot")
 
 _SCHEMA = 1
+
+# Latency gate for loaded AOT artifacts: a deserialized executable can run
+# yet be pathologically slow (measured on the axon PJRT tunnel: ~3 s/call
+# for a forward that plain jit serves in 0.2 ms — every call re-crossed the
+# tunnel). A tier whose steady-state probe call exceeds this is rejected
+# and the boot falls back to jit + the bundle's warm persistent cache.
+_MAX_CALL_MS = float(os.environ.get("LAMBDIPY_AOT_MAX_CALL_MS", "500"))
 
 
 def _env_key() -> dict:
@@ -57,6 +66,10 @@ class AotStore:
 
     def __init__(self, bundle_dir: Path):
         self.dir = Path(bundle_dir) / "aot"
+        self.rejected_slow = False  # set when a tier loaded but failed the gate
+        # set when a matching meta existed but produced no usable tier —
+        # the signal that re-saving would just reproduce the same artifacts
+        self.exhausted = False
 
     def _paths(self, name: str) -> dict[str, Path]:
         import jax
@@ -89,10 +102,21 @@ class AotStore:
         meta["tiers"] = []
 
         jitted = jax.jit(fn)
+        # plain call FIRST: this is the compile that flows through the
+        # persistent-cache writer. A manual lower().compile() pre-populates
+        # the jit dispatch cache WITHOUT writing the persistent cache
+        # (observed: bundles warmed compile-last shipped caches missing
+        # their own forward program), so order matters here.
+        jax.block_until_ready(jitted(*example_args))
+
         try:
             exported = jax.export.export(jitted)(*example_args)
             atomic_write_bytes(paths["hlo"], bytes(exported.serialize()))
             meta["tiers"].append("hlo")
+            # warm the hlo-tier boot path too: the round-tripped module
+            # hashes differently from the original jit, so compile it once
+            # here to put ITS cache entry in the bundle
+            jax.block_until_ready(jax.jit(exported.call)(*example_args))
         except Exception as e:
             log.warning("aot %s: jax.export failed: %s", name, e)
 
@@ -110,6 +134,66 @@ class AotStore:
             atomic_write_text(paths["meta"], json.dumps(meta, indent=1))
         return meta, jitted
 
+    def prune_slow_tiers(self, name: str, example_args: Sequence[Any]) -> list[str]:
+        """Build-time self-test: load each just-saved tier on THIS platform
+        and delete any that fail the latency gate, so the serve boot never
+        pays a slow probe for a tier that can't win (e.g. the exec tier on
+        the axon tunnel). Returns the pruned tier names."""
+        import jax
+
+        paths = self._paths(name)
+        if not paths["meta"].is_file():
+            return []
+        try:
+            meta = json.loads(paths["meta"].read_text())
+        except Exception:
+            return []
+        pruned = []
+        for tier in list(meta.get("tiers", ())):
+            try:
+                fn = self._load_tier(tier, paths)
+                if fn is None:
+                    continue
+                t0 = time.monotonic()
+                jax.block_until_ready(fn(*example_args))
+                first_ms = (time.monotonic() - t0) * 1000.0
+                t0 = time.monotonic()
+                jax.block_until_ready(fn(*example_args))
+                ms = (time.monotonic() - t0) * 1000.0
+                if ms > _MAX_CALL_MS:
+                    log.warning(
+                        "aot %s: pruning %s tier (steady %.0fms, first %.0fms, "
+                        "gate %.0fms)", name, tier, ms, first_ms, _MAX_CALL_MS)
+                    meta["tiers"].remove(tier)
+                    paths[tier].unlink(missing_ok=True)
+                    pruned.append(tier)
+            except Exception as e:
+                log.warning("aot %s: pruning %s tier (failed self-test: %s)",
+                            name, tier, e)
+                meta["tiers"].remove(tier)
+                paths[tier].unlink(missing_ok=True)
+                pruned.append(tier)
+        if pruned:
+            # keep the meta even when no tiers survive: it records "tried
+            # and pruned on this platform", which stops every subsequent
+            # boot from re-exporting/re-probing the same losing artifacts
+            atomic_write_text(paths["meta"], json.dumps(meta, indent=1))
+        return pruned
+
+    def _load_tier(self, tier: str, paths: dict):
+        """Deserialize one tier into a callable (no probing/gating)."""
+        import jax
+
+        if tier == "exec" and paths["exec"].is_file():
+            from jax.experimental import serialize_executable
+
+            payload = pickle.loads(paths["exec"].read_bytes())
+            return serialize_executable.deserialize_and_load(*payload)
+        if tier == "hlo" and paths["hlo"].is_file():
+            exported = jax.export.deserialize(bytearray(paths["hlo"].read_bytes()))
+            return jax.jit(exported.call)
+        return None
+
     # -- load ---------------------------------------------------------------
 
     def load(self, name: str,
@@ -120,8 +204,10 @@ class AotStore:
         When ``example_args`` is given each candidate tier is probe-invoked
         before being returned — an AOT executable can deserialize fine yet
         fail at call time (observed: XLA:CPU AOT rejects a host whose CPU
-        features differ from the compile machine). The probe doubles as the
-        warmup invoke, so it costs the boot path nothing.
+        features differ from the compile machine), or run but be unusably
+        slow (observed on the axon tunnel; see _MAX_CALL_MS). The first
+        probe call doubles as the warmup invoke; the gate times a second,
+        steady-state call.
         """
         paths = self._paths(name)
         if not paths["meta"].is_file():
@@ -137,36 +223,46 @@ class AotStore:
                      name, meta, env)
             return None
 
-        def _probe(fn: Callable) -> bool:
+        def _probe(fn: Callable, tier: str) -> bool:
+            """Correctness + latency gate. Raises on breakage; returns
+            False (and marks rejected_slow) on a gate failure. The steady
+            gate always uses a second call when the first is over budget;
+            the 4x short-circuit (cap the boot cost at one slow call)
+            applies only to the exec tier — an hlo tier's first call may
+            legitimately be a multi-second compile (e.g. the warm step
+            timed out and the bundle shipped without its cache entry)."""
             if example_args is None:
                 return True
             import jax
 
+            t0 = time.monotonic()
             jax.block_until_ready(fn(*example_args))
+            ms = (time.monotonic() - t0) * 1000.0
+            slow = tier == "exec" and ms > 4 * _MAX_CALL_MS
+            if not slow and ms > _MAX_CALL_MS:
+                t0 = time.monotonic()
+                jax.block_until_ready(fn(*example_args))
+                ms = (time.monotonic() - t0) * 1000.0
+                slow = ms > _MAX_CALL_MS
+            if slow:
+                self.rejected_slow = True
+                log.warning(
+                    "aot %s: %s tier call %.0fms exceeds gate %.0fms; "
+                    "rejecting (plain jit + warm cache will serve)",
+                    name, tier, ms, _MAX_CALL_MS)
+                return False
             return True
 
-        if "exec" in meta.get("tiers", ()) and paths["exec"].is_file():
+        for tier in ("exec", "hlo"):
+            if tier not in meta.get("tiers", ()):
+                continue
             try:
-                from jax.experimental import serialize_executable
-
-                payload = pickle.loads(paths["exec"].read_bytes())
-                compiled = serialize_executable.deserialize_and_load(*payload)
-                _probe(compiled)
-                return compiled, "exec"
+                fn = self._load_tier(tier, paths)
+                if fn is not None and _probe(fn, tier):
+                    return fn, tier
             except Exception as e:
-                log.warning("aot %s: exec tier failed to load: %s", name, e)
-
-        if "hlo" in meta.get("tiers", ()) and paths["hlo"].is_file():
-            try:
-                import jax
-
-                exported = jax.export.deserialize(
-                    bytearray(paths["hlo"].read_bytes()))
-                fn = jax.jit(exported.call)
-                _probe(fn)
-                return fn, "hlo"
-            except Exception as e:
-                log.warning("aot %s: hlo tier failed to load: %s", name, e)
+                log.warning("aot %s: %s tier failed to load: %s", name, tier, e)
+        self.exhausted = True  # meta matched this env; nothing usable in it
         return None
 
 
@@ -189,8 +285,15 @@ def cached_jit(ctx, name: str, fn: Callable,
     hit = store.load(name, example_args)
     if hit is not None:
         return hit
+    if store.exhausted or store.rejected_slow:
+        # a matching meta already records that this platform's artifacts
+        # don't work (or are slower than the gate) — re-saving would just
+        # reproduce them; serve from jit, whose compile is a hit in the
+        # bundle's warm persistent cache
+        return jax.jit(fn), "jit"
     try:
         _, jitted = store.save(name, fn, example_args)
+        store.prune_slow_tiers(name, example_args)
         return jitted, "jit"
     except Exception as e:  # bundle dir read-only, export unsupported, ...
         log.info("aot %s: save skipped: %s", name, e)

@@ -31,3 +31,27 @@ def apply_platform_override() -> str | None:
     except Exception as e:
         log.warning("platform override %r failed: %s", platform, e)
         return None
+
+
+def prefer_cpu_backend() -> bool:
+    """Keep this process off the accelerator: switch jax to CPU if the
+    backend hasn't initialized yet (no-op otherwise, returns False).
+
+    Used by build-time steps whose math doesn't need the device (param
+    init, weight conversion): on this image the TPU tunnel is effectively
+    single-client (measured: a build process holding it starves the warm
+    subprocess, which is the step that actually must run on the device to
+    populate the bundle's compile cache)."""
+    if os.environ.get("LAMBDIPY_PLATFORM"):
+        return False  # explicit override wins
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            return False
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception as e:
+        log.warning("cpu preference failed: %s", e)
+        return False
