@@ -441,6 +441,74 @@ def deployments_cmd():
         click.echo(f"{dep.name:25s} pid={dep.pid:<8d} {dep.url}")
 
 
+@main.command("train")
+@click.option("--model", "model_name", default="llama-tiny",
+              help="registry model (llama-tiny / llama3-8b / llama-moe-tiny ...)")
+@click.option("--data", "data_path", type=click.Path(exists=True), required=True,
+              help="token file (.npy or raw int32 binary)")
+@click.option("--steps", type=int, default=100)
+@click.option("--batch", "global_batch", type=int, default=8)
+@click.option("--seq-len", type=int, default=128)
+@click.option("--lr", type=float, default=1e-3)
+@click.option("--ckpt-dir", type=click.Path(), default=None,
+              help="checkpoint dir; re-running resumes from the latest step")
+@click.option("--ckpt-every", type=int, default=50)
+@click.option("--mesh", "mesh_spec", default=None,
+              help='mesh axes, e.g. "dp=2,tp=2" (default: all devices on dp)')
+@click.option("--seed", type=int, default=0)
+def train_cmd(model_name, data_path, steps, global_batch, seq_len, lr,
+              ckpt_dir, ckpt_every, mesh_spec, seed):
+    """Train a registry model on a token file (resumable SPMD loop)."""
+    import jax
+
+    from lambdipy_tpu.data import ShardedLoader, TokenSource
+    from lambdipy_tpu.models import registry as model_registry
+    from lambdipy_tpu.parallel.distributed import initialize_from_env
+    from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+    from lambdipy_tpu.train.loop import Trainer, TrainerConfig
+
+    initialize_from_env()
+    adapter = model_registry.get(model_name).build()
+    params = adapter.init_params(seed=seed)
+    if mesh_spec:
+        shape = {}
+        for part in mesh_spec.split(","):
+            axis, eq, size = part.partition("=")
+            try:
+                if not eq:
+                    raise ValueError("missing '='")
+                shape[axis.strip()] = int(size)
+            except ValueError as e:
+                raise click.ClickException(
+                    f"bad --mesh entry {part!r} (want axis=size, e.g. "
+                    f"dp=2,tp=4): {e}") from e
+        if any(v == -1 for v in shape.values()):
+            devices = jax.devices()  # -1 fills: make_mesh needs them all
+        else:
+            needed = 1
+            for v in shape.values():
+                needed *= v
+            devices = jax.devices()[:needed]
+        mesh = make_mesh(shape, devices=devices)
+    else:
+        mesh = make_mesh({"dp": len(jax.devices())})
+    loader = ShardedLoader(TokenSource(data_path, seq_len), global_batch,
+                           seed=seed)
+    cfg = TrainerConfig(total_steps=steps, learning_rate=lr,
+                        ckpt_every=ckpt_every)
+    with use_mesh(mesh):
+        with Trainer(adapter.forward, params, mesh, adapter.tp_rules,
+                     loader, cfg, ckpt_dir=ckpt_dir,
+                     model_apply_aux=adapter.forward_with_aux) as trainer:
+            report = trainer.run()
+    last = report.history[-1] if report.history else {}
+    click.echo(json.dumps({
+        "model": model_name, "final_step": report.final_step,
+        "steps_run": report.steps_run, "resumed_from": report.resumed_from,
+        "mesh": dict(mesh.shape), "final_metrics": last,
+    }))
+
+
 @main.command("bench")
 @click.argument("name")
 @click.option("--data", default='{"random": true}', help="JSON request body")
