@@ -64,7 +64,9 @@ class EncoderLayer(nn.Module):
         y = SelfAttention(cfg, name="attn")(x, mask)
         x = ln("ln_attn")(x + y).astype(cfg.dtype)
         y = nn.Dense(cfg.mlp, dtype=cfg.dtype, name="mlp_in")(x)
-        y = nn.gelu(y)
+        # exact (erf) gelu, matching the BERT paper / HF checkpoints so
+        # imported weights reproduce reference logits (convert.py)
+        y = nn.gelu(y, approximate=False)
         y = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="mlp_out")(y)
         return ln("ln_mlp")(x + y).astype(cfg.dtype)
 

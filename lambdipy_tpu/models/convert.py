@@ -137,6 +137,91 @@ def jax_tree_leaves(tree):
     return jax.tree_util.tree_leaves(tree)
 
 
+def bert_config_from_hf(hf_cfg: dict, num_classes: int, **overrides):
+    from lambdipy_tpu.models.bert import BertConfig
+
+    import jax.numpy as jnp
+
+    cfg = BertConfig(
+        vocab_size=int(hf_cfg["vocab_size"]),
+        hidden=int(hf_cfg["hidden_size"]),
+        layers=int(hf_cfg["num_hidden_layers"]),
+        heads=int(hf_cfg["num_attention_heads"]),
+        mlp=int(hf_cfg["intermediate_size"]),
+        max_len=int(hf_cfg.get("max_position_embeddings", 512)),
+        type_vocab=int(hf_cfg.get("type_vocab_size", 2)),
+        num_classes=num_classes,
+        dtype=jnp.bfloat16,
+    )
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def import_hf_bert(source, *, config_overrides: dict | None = None):
+    """Convert an HF ``BertForSequenceClassification`` checkpoint (or local
+    path) into (BertConfig, params) for models/bert.py BertClassifier.
+
+    Mapping notes: torch Linear [out, in] -> [in, out] kernels; the q/k/v
+    projections reshape into DenseGeneral's [hidden, heads, head_dim], the
+    output projection into [heads, head_dim, hidden]; LayerNorm
+    weight/bias -> scale/bias. Parity verified in tests/test_convert.py.
+    """
+    if isinstance(source, (str, Path)):
+        from transformers import AutoModelForSequenceClassification
+
+        source = AutoModelForSequenceClassification.from_pretrained(
+            str(source), local_files_only=True)
+    sd = {k: _to_numpy(v) for k, v in source.state_dict().items()}
+    hf_cfg = source.config.to_dict()
+    num_classes = sd["classifier.weight"].shape[0]
+    cfg = bert_config_from_hf(hf_cfg, num_classes, **(config_overrides or {}))
+    h, heads, hd = cfg.hidden, cfg.heads, cfg.hidden // cfg.heads
+
+    def lin(name):
+        return {"kernel": np.ascontiguousarray(sd[f"{name}.weight"].T),
+                "bias": sd[f"{name}.bias"]}
+
+    def qkv(name):  # [h_out, h_in] -> kernel [h_in, heads, head_dim]
+        return {"kernel": np.ascontiguousarray(
+                    sd[f"{name}.weight"].T.reshape(h, heads, hd)),
+                "bias": sd[f"{name}.bias"].reshape(heads, hd)}
+
+    def ln(name):
+        return {"scale": sd[f"{name}.weight"], "bias": sd[f"{name}.bias"]}
+
+    enc: dict = {
+        "tok_emb": {"embedding": sd["bert.embeddings.word_embeddings.weight"]},
+        "pos_emb": {"embedding": sd["bert.embeddings.position_embeddings.weight"]},
+        "type_emb": {"embedding": sd["bert.embeddings.token_type_embeddings.weight"]},
+        "emb_ln": ln("bert.embeddings.LayerNorm"),
+    }
+    for i in range(cfg.layers):
+        hf = f"bert.encoder.layer.{i}"
+        enc[f"layer_{i}"] = {
+            "attn": {
+                "query": qkv(f"{hf}.attention.self.query"),
+                "key": qkv(f"{hf}.attention.self.key"),
+                "value": qkv(f"{hf}.attention.self.value"),
+                # output projection: [h_out, h_in] -> [heads, head_dim, h]
+                "out": {"kernel": np.ascontiguousarray(
+                            sd[f"{hf}.attention.output.dense.weight"].T
+                            .reshape(heads, hd, h)),
+                        "bias": sd[f"{hf}.attention.output.dense.bias"]},
+            },
+            "ln_attn": ln(f"{hf}.attention.output.LayerNorm"),
+            "mlp_in": lin(f"{hf}.intermediate.dense"),
+            "mlp_out": lin(f"{hf}.output.dense"),
+            "ln_mlp": ln(f"{hf}.output.LayerNorm"),
+        }
+    params = {
+        "encoder": enc,
+        "pooler": lin("bert.pooler.dense"),
+        "classifier": lin("classifier"),
+    }
+    n = sum(v.size for v in jax_tree_leaves(params))
+    log_event(log, "hf bert imported", layers=cfg.layers, n_params=int(n))
+    return cfg, {"params": params}
+
+
 def save_hf_params(hf_path: str | Path, params_dir: Path, *,
                    quant: str | None = None) -> dict:
     """Bundle-build hook: convert a local HF Llama checkpoint and persist
