@@ -139,17 +139,29 @@ def rope(q, k, positions, theta: float):
 
 
 def _attend(q, k, v, mask):
-    """Grouped-query attention core. q: [b,s,h,d]; k/v: [b,t,kvh,d]."""
+    """Grouped-query attention core. q: [b,s,h,d]; k/v: [b,t,kvh,d].
+
+    The shard_hints pin ONE layout through softmax and its jvp/transpose —
+    batch over dp, kv-heads over tp, query seq over sp, key seq gathered
+    (replicated over sp) — so the SPMD partitioner never falls back to
+    involuntary full rematerialization bouncing between dp- and sp-sharded
+    logits (ring attention is the layout that never gathers k/v)."""
+    from lambdipy_tpu.parallel.sharding import shard_hint
+
     b, s, h, d = q.shape
     kvh = k.shape[2]
     group = h // kvh
-    q = q.reshape(b, s, kvh, group, d)
+    q = shard_hint(q.reshape(b, s, kvh, group, d), "dp", "sp", "tp")
+    k = shard_hint(k, "dp", None, "tp")
+    v = shard_hint(v, "dp", None, "tp")
     logits = jnp.einsum("bskgd,btkd->bkgst", q, k).astype(jnp.float32)
-    logits = logits / jnp.sqrt(d).astype(jnp.float32)
+    logits = shard_hint(logits / jnp.sqrt(d).astype(jnp.float32),
+                        "dp", "tp", None, "sp", None)
     logits = jnp.where(mask[:, None, None, :, :], logits, jnp.float32(-1e9))
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    probs = shard_hint(probs, "dp", "tp", None, "sp", None)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
-    return out.reshape(b, s, h, d)
+    return shard_hint(out.reshape(b, s, h, d), "dp", "sp", "tp")
 
 
 class LlamaBlock(nn.Module):
@@ -163,9 +175,13 @@ class LlamaBlock(nn.Module):
         if backend == "ring":
             from lambdipy_tpu.parallel.mesh import current_mesh
             from lambdipy_tpu.parallel.ring import ring_attention
+            from lambdipy_tpu.parallel.sharding import shard_hints_suppressed
 
             mesh = current_mesh()
-            if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            # inside a manual region (e.g. a pipeline stage body) a nested
+            # whole-mesh shard_map cannot trace — fall back to dense there
+            if (mesh is not None and mesh.shape.get("sp", 1) > 1
+                    and not shard_hints_suppressed()):
                 # sequence-parallel long-context path; padding mask is
                 # carried by the causal structure (callers pad right and
                 # ignore tail logits)

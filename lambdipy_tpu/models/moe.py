@@ -28,18 +28,6 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
-def _ep_constraint(x, spec_entries):
-    """Constrain ``x``'s sharding when an ep-carrying mesh is ambient."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from lambdipy_tpu.parallel.mesh import current_mesh
-    from lambdipy_tpu.parallel.sharding import _filter_spec
-
-    mesh = current_mesh()
-    if mesh is None or "ep" not in mesh.axis_names:
-        return x
-    spec = _filter_spec(P(*spec_entries), mesh, x.ndim)
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def route_topk(probs, top_k: int, capacity: int):
@@ -132,14 +120,16 @@ class MoEMLP(nn.Module):
         w_up = self._expert_weight("experts_up", (e, hidden, m))
         w_down = self._expert_weight("experts_down", (e, m, hidden))
 
+        from lambdipy_tpu.parallel.sharding import shard_hint
+
         # dispatch all-to-all: tokens (dp-sharded) -> expert shards (ep)
         xe = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
                         tokens.astype(self.dtype))
-        xe = _ep_constraint(xe, ("ep", None, None))
+        xe = shard_hint(xe, "ep")
         gate = jnp.einsum("ech,ehm->ecm", xe, w_gate)
         up = jnp.einsum("ech,ehm->ecm", xe, w_up)
         ye = jnp.einsum("ecm,emh->ech", nn.silu(gate) * up, w_down)
-        ye = _ep_constraint(ye, ("ep", None, None))
+        ye = shard_hint(ye, "ep")
         # combine all-to-all back to token order, weighted by router gates
         out = jnp.einsum("tec,ech->th", combine.astype(self.dtype), ye)
         return out.reshape(b, s, hidden).astype(x.dtype)

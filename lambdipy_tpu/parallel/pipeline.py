@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from lambdipy_tpu.parallel.sharding import no_shard_hints
+
 
 def split_microbatches(batch, num_microbatches: int):
     """[B, ...] -> [nmb, B/nmb, ...] (leading-dim split, order preserved)."""
@@ -118,4 +120,7 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh, *,
         in_specs=(params_specs, x_spec, const_specs),
         out_specs=x_spec,
     )
-    return fn(stacked_params, microbatches, const)
+    # stage_fn bodies trace inside the manual region — whole-mesh
+    # constraints (models' shard_hint calls) must not fire there
+    with no_shard_hints():
+        return fn(stacked_params, microbatches, const)

@@ -10,6 +10,8 @@ reduce-scatters on ICI).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import fnmatch
 from dataclasses import dataclass
 
@@ -88,6 +90,58 @@ def param_shardings(params, mesh: Mesh, rules: ShardingRules):
             mesh, _filter_spec(rules.spec_for(_path_str(key_path)), mesh, leaf.ndim))
 
     return jax.tree_util.tree_map_with_path(spec, params)
+
+
+_HINTS_DISABLED: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "lambdipy_shard_hints_disabled", default=False)
+
+
+@contextlib.contextmanager
+def no_shard_hints():
+    """Disable :func:`shard_hint` while tracing manual (shard_map) bodies,
+    where whole-mesh sharding constraints are invalid — the per-device code
+    there already owns its layout."""
+    token = _HINTS_DISABLED.set(True)
+    try:
+        yield
+    finally:
+        _HINTS_DISABLED.reset(token)
+
+
+def shard_hint(x, *entries):
+    """Best-effort ``with_sharding_constraint`` against the ambient mesh.
+
+    Entries are mesh axis names (or None) per array dim, truncated/padded to
+    the rank. Axes absent from the ambient mesh — or larger than the dim
+    they would split — are dropped, so models stay mesh-agnostic: the same
+    call is a no-op single-chip, pins tp/sp layouts when those axes exist,
+    and is suppressed inside shard_map regions (:func:`no_shard_hints`).
+    """
+    if _HINTS_DISABLED.get():
+        return x
+    from lambdipy_tpu.parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = _filter_spec(P(*entries), mesh, x.ndim)
+    kept = []
+    for i, e in enumerate(spec):
+        size = 1
+        for a in (e if isinstance(e, tuple) else (e,)) if e else ():
+            size *= mesh.shape[a]
+        kept.append(e if size <= x.shape[i] else None)
+    if all(e is None for e in kept):
+        # no requested axis exists on this mesh — leave the layout to the
+        # partitioner rather than forcing replication
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*kept)))
+
+
+def shard_hints_suppressed() -> bool:
+    """True while tracing a manual (shard_map) region — whole-mesh
+    constraints and nested whole-mesh shard_maps are both invalid there."""
+    return _HINTS_DISABLED.get()
 
 
 def shard_batch(batch, mesh: Mesh, axis: str = "dp"):
