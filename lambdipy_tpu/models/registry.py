@@ -68,6 +68,9 @@ class JaxModel:
     tp_rules: Any  # ShardingRules
     forward: Callable[..., Any]  # (params, *batch) -> output
     generate: Callable[..., Any] | None = None
+    # (params, mesh=None, **caps) -> a compile-once serving decoder
+    # (llama.LlamaServer): prompt-length bucketing + runtime sampling knobs
+    make_server: Callable[..., Any] | None = None
     config: Any = None
     # (params, *batch) -> (output, aux_loss) for models with an auxiliary
     # training loss (MoE router balance); feed to sharded_train_step's
@@ -255,12 +258,18 @@ def _build_llama(cfg) -> JaxModel:
                                               mutable=["intermediates"])
             return logits, moe_aux_loss(state["intermediates"])
 
+    def make_server(params, mesh=None, **caps):
+        from lambdipy_tpu.models.llama import LlamaServer
+
+        return LlamaServer(module, params, mesh=mesh, **caps)
+
     return JaxModel(
         module=module,
         example_batch=example_batch,
         tp_rules=_llama_tp_rules(),
         forward=lambda params, tokens: module.apply(params, tokens)[0],
         generate=generate,
+        make_server=make_server,
         config=cfg,
         forward_with_aux=forward_with_aux,
     )

@@ -257,7 +257,15 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
 
     adapter, params = _jax_adapter_and_params(spec, ctx)
     params, mesh = _maybe_shard(adapter, params, spec)
-    default_new = int((spec.get("extra") or {}).get("max_new_tokens", 16))
+    extra = spec.get("extra") or {}
+    default_new = int(extra.get("max_new_tokens", 16))
+    # compile-once serving: prompt-length bucketing + runtime sampling
+    # knobs, one compiled program per shape bucket (llama.LlamaServer)
+    server = None
+    if adapter.make_server is not None:
+        server = adapter.make_server(
+            params, mesh=mesh,
+            decode_cap=int(extra.get("decode_cap", max(default_new, 256))))
 
     tokenizer, tok_err = None, None
     tok_path = (spec.get("extra") or {}).get("tokenizer_path")
@@ -279,6 +287,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             tok_err = str(e)
 
     def run(prompt, max_new, sample_kwargs):
+        if server is not None:
+            return server.generate(prompt, max_new_tokens=max_new,
+                                   **sample_kwargs)
         if mesh is not None:
             with mesh:
                 return adapter.generate(params, prompt, max_new_tokens=max_new,
@@ -305,7 +316,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             if raw.size == 0:
                 return {"ok": False, "error": "empty prompt"}
             prompt = jnp.asarray(raw[None, :] if raw.ndim == 1 else raw)
-        max_new = int(req.get("max_new_tokens", default_new))
+        # tolerate JSON null (= "use the default"); explicit 0 is honored
+        raw_new = req.get("max_new_tokens")
+        max_new = default_new if raw_new is None else int(raw_new)
         # every knob tolerates JSON null (= "use the default")
         sample_kwargs = {
             "temperature": float(req.get("temperature") or 0.0),

@@ -264,3 +264,28 @@ def test_server_drain_rejects_new_invokes(llama_bundle):
     finally:
         server.draining = False
         threading.Thread(target=server.stop, daemon=True).start()
+
+
+def test_generate_handler_null_knobs(llama_bundle):
+    """JSON null for every sampling knob (incl. max_new_tokens) means 'use
+    the default' — it must not 500 (VERDICT r2 weak #7)."""
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    report = load_bundle(llama_bundle)
+    out = report.handler.invoke(report.state, {
+        "tokens": [1, 2, 3], "max_new_tokens": None, "temperature": None,
+        "top_k": None, "top_p": None, "seed": None, "eos_id": None})
+    assert out["ok"] and out["n_new"] == 4  # bundle default_new
+
+
+def test_generate_handler_serves_compile_once(llama_bundle):
+    """The handler routes through LlamaServer: varied lengths and knobs in
+    one bucket reuse a single compiled program."""
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    report = load_bundle(llama_bundle)
+    r1 = report.handler.invoke(report.state, {"tokens": [1, 2, 3]})
+    r2 = report.handler.invoke(report.state, {
+        "tokens": [4, 5, 6, 7, 8], "temperature": 0.9, "top_k": 3,
+        "seed": 5})
+    assert r1["ok"] and r2["ok"]
