@@ -182,10 +182,11 @@ class LlamaBlock(nn.Module):
             # whole-mesh shard_map cannot trace — fall back to dense there
             if (mesh is not None and mesh.shape.get("sp", 1) > 1
                     and not shard_hints_suppressed()):
-                # sequence-parallel long-context path; padding mask is
-                # carried by the causal structure (callers pad right and
-                # ignore tail logits)
-                return ring_attention(q, k, v, mesh, causal=True)
+                # sequence-parallel long-context path; the padding mask is
+                # threaded as the ring's key-validity mask, so padded
+                # batches match the dense backend exactly
+                return ring_attention(q, k, v, mesh, causal=True,
+                                      kv_mask=mask)
             backend = "dense"  # no sp axis -> fall through
         if backend == "flash":
             from lambdipy_tpu.ops.attention import flash_attention
@@ -529,12 +530,14 @@ class LlamaServer:
     """
 
     def __init__(self, model: LlamaModel, params, *, mesh=None,
-                 min_bucket: int = 16, decode_cap: int = 256):
+                 min_bucket: int = 16, decode_cap: int | None = None):
         self.model = model
         self.params = params
         self.mesh = mesh
         self.min_bucket = min_bucket
-        self.decode_cap = decode_cap
+        # default: anything the context window allows is servable (power-
+        # of-two bucketing bounds distinct compiles at log2(max_len))
+        self.decode_cap = decode_cap or model.cfg.max_len
         self._fns: dict[tuple[int, int], Any] = {}
 
     @property
@@ -570,6 +573,8 @@ class LlamaServer:
         b, s = ids.shape
         if s < 1:
             raise ValueError("empty prompt")
+        if max_new_tokens < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
         if max_new_tokens > self.decode_cap:
             raise ValueError(
                 f"max_new_tokens {max_new_tokens} exceeds the server's "

@@ -23,10 +23,11 @@ NEG_INF = -1e30
 
 def _block_attend(q, k, v, mask, scale):
     """One blockwise attention contribution. q: [b,sq,h,d]; k/v: [b,sk,h,d];
-    mask: [sq, sk] bool or None. Returns (m, l, acc) partials in f32."""
+    mask: bool broadcastable to [b,h,sq,sk], or None. Returns (m, l, acc)
+    partials in f32."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1)  # [b,h,q]
     # guard fully-masked rows: exp(NEG_INF - NEG_INF) would give 1s
     m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
@@ -50,9 +51,11 @@ def _combine(m1, l1, acc1, m2, l2, acc2):
     return m, l, acc
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
-                          vary_axes: tuple[str, ...] = ()):
-    """Per-shard body (runs inside shard_map). q/k/v: [b, s_local, h, d]."""
+def _ring_attention_local(q, k, v, km=None, *, axis_name: str, causal: bool,
+                          scale: float, vary_axes: tuple[str, ...] = ()):
+    """Per-shard body (runs inside shard_map). q/k/v: [b, s_local, h, d];
+    km: [b, s_local] bool key-validity block (padding mask) or None — it
+    rotates around the ring with its k/v block."""
     sp = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
@@ -70,32 +73,43 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float
     acc0 = varying(jnp.zeros((b, sq, h, d), jnp.float32))
 
     def step(carry, i):
-        m, l, acc, kb, vb = carry
+        m, l, acc, kb, vb, kmb = carry
         src = (my - i) % sp  # which global block this kv currently is
         if causal:
             # src < my: fully visible; src == my: causal; src > my: skip
-            mask = jnp.where(src < my, jnp.ones((sq, sq), jnp.bool_),
-                             jnp.where(src == my, causal_block,
-                                       jnp.zeros((sq, sq), jnp.bool_)))
+            pos = jnp.where(src < my, jnp.ones((sq, sq), jnp.bool_),
+                            jnp.where(src == my, causal_block,
+                                      jnp.zeros((sq, sq), jnp.bool_)))
+            mask = pos[None, None]  # [1,1,sq,sk]
         else:
             mask = None
+        if kmb is not None:
+            kmask = kmb[:, None, None, :]  # [b,1,1,sk]
+            mask = kmask if mask is None else mask & kmask
         bm, bl, bacc = _block_attend(q, kb, vb, mask, scale)
         m, l, acc = _combine(m, l, acc, bm, bl, bacc)
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
-        return (m, l, acc, kb, vb), None
+        if kmb is not None:
+            kmb = jax.lax.ppermute(kmb, axis_name, perm)
+        return (m, l, acc, kb, vb, kmb), None
 
-    (m, l, acc, _, _), _ = jax.lax.scan(
-        step, (m0, l0, acc0, k, v), jnp.arange(sp))
+    carry0 = (m0, l0, acc0, k, v, None if km is None else km)
+    (m, l, acc, _, _, _), _ = jax.lax.scan(step, carry0, jnp.arange(sp))
     l = jnp.maximum(l, 1e-30)
     out = acc / jnp.transpose(l, (0, 2, 1))[..., None]
     return out.astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
-                   causal: bool = True, scale: float | None = None):
+                   causal: bool = True, scale: float | None = None,
+                   kv_mask=None):
     """Full attention over sequence-sharded q/k/v: [b, s, h, d] with the
-    ``s`` dim sharded over ``axis``. GQA kv heads are broadcast first."""
+    ``s`` dim sharded over ``axis``. GQA kv heads are broadcast first.
+
+    kv_mask: optional [b, s] bool key-validity (padding) mask, sharded like
+    the sequence; masked key positions are excluded on every ring step, so
+    padded batches attend identically to the dense backend."""
     h, kvh = q.shape[2], k.shape[2]
     if kvh != h:
         rep = h // kvh
@@ -104,8 +118,13 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
     spec = P(batch_axes if batch_axes else None, axis, None, None)
-    fn = jax.shard_map(
-        partial(_ring_attention_local, axis_name=axis, causal=causal, scale=scale,
-                vary_axes=batch_axes + (axis,)),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    local = partial(_ring_attention_local, axis_name=axis, causal=causal,
+                    scale=scale, vary_axes=batch_axes + (axis,))
+    if kv_mask is not None:
+        mspec = P(batch_axes if batch_axes else None, axis)
+        fn = jax.shard_map(local, mesh=mesh,
+                           in_specs=(spec, spec, spec, mspec), out_specs=spec)
+        return fn(q, k, v, kv_mask)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
     return fn(q, k, v)

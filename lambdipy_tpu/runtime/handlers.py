@@ -263,9 +263,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     # knobs, one compiled program per shape bucket (llama.LlamaServer)
     server = None
     if adapter.make_server is not None:
+        cap = extra.get("decode_cap")  # None = full context window
         server = adapter.make_server(
-            params, mesh=mesh,
-            decode_cap=int(extra.get("decode_cap", max(default_new, 256))))
+            params, mesh=mesh, decode_cap=int(cap) if cap else None)
 
     tokenizer, tok_err = None, None
     tok_path = (spec.get("extra") or {}).get("tokenizer_path")

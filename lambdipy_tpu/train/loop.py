@@ -31,6 +31,14 @@ class TrainerConfig:
     keep_ckpts: int = 3
     fsdp: bool = True
     aux_weight: float = 0.01
+    # optimizer stack (train/step.py make_optimizer): global-norm clipping,
+    # warmup / cosine decay, gradient accumulation (total_steps counts
+    # micro-steps; params update every accum_steps-th step)
+    grad_clip: float | None = 1.0
+    warmup_steps: int = 0
+    schedule: str = "constant"  # "constant" | "cosine"
+    weight_decay: float = 0.0
+    accum_steps: int = 1
 
 
 @dataclass
@@ -56,17 +64,23 @@ class Trainer:
         import jax
 
         from lambdipy_tpu.train.checkpoint import TrainCheckpointer
-        from lambdipy_tpu.train.step import sharded_train_step
+        from lambdipy_tpu.train.step import make_optimizer, sharded_train_step
 
         self.cfg = cfg
         self.mesh = mesh
         self.loader = loader
         self.model_apply = model_apply
         self._jax = jax
+        optimizer = make_optimizer(
+            cfg.learning_rate, total_steps=cfg.total_steps,
+            warmup_steps=cfg.warmup_steps, schedule=cfg.schedule,
+            grad_clip=cfg.grad_clip, weight_decay=cfg.weight_decay,
+            accum_steps=cfg.accum_steps)
         self.step_fn, self.state, self.batch_sharding = sharded_train_step(
             model_apply, params, mesh, rules,
             learning_rate=cfg.learning_rate, fsdp=cfg.fsdp,
-            model_apply_aux=model_apply_aux, aux_weight=cfg.aux_weight)
+            model_apply_aux=model_apply_aux, aux_weight=cfg.aux_weight,
+            optimizer=optimizer)
 
         self.ckpt: Any = None
         self.resumed_from: int | None = None

@@ -53,6 +53,46 @@ def train_shardings(params, mesh: Mesh, rules: ShardingRules, *, fsdp: bool = Tr
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
+def make_optimizer(learning_rate: float, *, total_steps: int | None = None,
+                   warmup_steps: int = 0, schedule: str = "constant",
+                   grad_clip: float | None = None, weight_decay: float = 0.0,
+                   accum_steps: int = 1) -> optax.GradientTransformation:
+    """The trainer's optimizer stack: [clip] -> adamw(lr schedule), wrapped
+    in optax.MultiSteps for gradient accumulation when ``accum_steps > 1``
+    (each call then adds one micro-batch; params update every k-th call).
+
+    schedule: "constant" (optional linear warmup) or "cosine"
+    (warmup + cosine decay to 0 over ``total_steps``). ``total_steps`` and
+    ``warmup_steps`` are MICRO-steps (optimizer calls): MultiSteps only
+    advances the inner schedule once per accumulated update, so the
+    horizons are rescaled by ``accum_steps`` here — the schedule completes
+    exactly when the configured micro-step budget does.
+    """
+    if accum_steps > 1:
+        total_steps = total_steps and max(1, total_steps // accum_steps)
+        warmup_steps = warmup_steps // accum_steps
+    lr: Any
+    if schedule == "cosine":
+        if not total_steps:
+            raise ValueError("cosine schedule needs total_steps")
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, learning_rate, warmup_steps,
+            max(total_steps, warmup_steps + 1))
+    elif schedule == "constant":
+        lr = (optax.linear_schedule(0.0, learning_rate, warmup_steps)
+              if warmup_steps else learning_rate)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    parts = []
+    if grad_clip:
+        parts.append(optax.clip_by_global_norm(grad_clip))
+    parts.append(optax.adamw(lr, weight_decay=weight_decay))
+    tx = optax.chain(*parts) if len(parts) > 1 else parts[0]
+    if accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum_steps)
+    return tx
+
+
 def make_train_step(model_apply: Callable, optimizer: optax.GradientTransformation,
                     *, model_apply_aux: Callable | None = None,
                     aux_weight: float = 0.01):
@@ -105,13 +145,17 @@ jax.tree_util.register_dataclass(
 def sharded_train_step(model_apply: Callable, params, mesh: Mesh,
                        rules: ShardingRules, *, learning_rate: float = 1e-3,
                        fsdp: bool = True, model_apply_aux: Callable | None = None,
-                       aux_weight: float = 0.01):
+                       aux_weight: float = 0.01,
+                       optimizer: optax.GradientTransformation | None = None):
     """Convenience: build everything for an SPMD training loop.
 
     Returns (jitted_step, sharded_state, batch_sharding). The batch spec
     shards batch over dp and sequence over sp when those axes exist.
+    Pass ``optimizer`` (e.g. :func:`make_optimizer` with clipping /
+    schedule / accumulation) to override the plain-adamw default.
     """
-    optimizer = optax.adamw(learning_rate)
+    if optimizer is None:
+        optimizer = optax.adamw(learning_rate)
     p_shardings = train_shardings(params, mesh, rules, fsdp=fsdp)
     # place via a jitted identity, NOT device_put: the step donates state
     # buffers, and device_put can alias (observed on CPU even with

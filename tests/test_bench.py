@@ -1,0 +1,70 @@
+"""bench.py orchestration: staged probes, per-stage timeouts, wedge
+diagnosis, fallback, and compile-cache persistence across attempts
+(VERDICT r2 weak #4). All runs forced onto CPU with the tiny model so no
+real chip is touched."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(tmp_path, extra_env, timeout=900):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update({
+        "LAMBDIPY_BENCH_FORCE_PLATFORM": "cpu",
+        "LAMBDIPY_BENCH_MODEL": "resnet50-tiny",
+        "LAMBDIPY_BENCH_CACHE": str(tmp_path / "compile-cache"),
+        **extra_env,
+    })
+    proc = subprocess.run([sys.executable, BENCH], capture_output=True,
+                          text=True, env=env, timeout=timeout)
+    line = proc.stdout.strip().splitlines()[-1]
+    return proc.returncode, json.loads(line)
+
+
+@pytest.mark.slow
+def test_bench_happy_path_reports_stages(tmp_path):
+    rc, out = _run_bench(tmp_path, {})
+    assert rc == 0
+    assert out["metric"] == "resnet50-tiny_b1_fwd_p50"
+    assert out["value"] > 0 and out["platform"] == "cpu"
+    assert out["stages"]["device.devices"] == "ok"
+    assert out["stages"]["device.matmul"] == "ok"
+    assert out["stages"]["device.model"] == "ok"
+
+
+@pytest.mark.slow
+def test_bench_wedge_is_diagnosed_and_falls_back(tmp_path):
+    """A wedged primary attempt is killed by the per-stage timeout, named
+    in the stages log, and the fallback attempt still produces a metric."""
+    rc, out = _run_bench(tmp_path, {
+        "LAMBDIPY_BENCH_WEDGE": "device.devices",
+        "LAMBDIPY_BENCH_PROBE_TIMEOUT": "20",
+    })
+    assert rc == 0
+    assert "wedge" in out["stages"]["device.devices"]
+    assert out["stages"]["cpu.model"] == "ok"
+    assert out["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_model_wedge_reuses_compile_cache(tmp_path):
+    """Kill the primary attempt at the model stage; the retry must hit the
+    persistent compile cache (first_compile_s collapses)."""
+    rc_cold, cold = _run_bench(tmp_path, {})
+    rc, out = _run_bench(tmp_path, {
+        "LAMBDIPY_BENCH_WEDGE": "device.model",
+        "LAMBDIPY_BENCH_TIMEOUT": "30",
+    })
+    assert rc_cold == 0 and rc == 0
+    assert "wedge" in out["stages"]["device.model"]
+    assert out["stages"]["cpu.model"] == "ok"
+    # cached compile must be far cheaper than the cold one
+    assert out["first_compile_s"] <= max(0.5, cold["first_compile_s"] / 2)

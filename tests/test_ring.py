@@ -95,3 +95,31 @@ def test_llama_flash_backend_matches_dense():
     out, _ = LlamaModel(cfg_flash).apply(params, tokens)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                rtol=5e-4, atol=5e-4)
+
+
+def test_ring_attention_respects_padding_mask(cpu_devices):
+    """A padded batch attends identically under ring and dense backends —
+    the kv mask rides the ring with its k/v block (VERDICT r2 weak #8)."""
+    import numpy as np
+    from lambdipy_tpu.models.llama import _attend
+    from lambdipy_tpu.parallel.mesh import make_mesh
+    from lambdipy_tpu.parallel.ring import ring_attention
+
+    rng = np.random.default_rng(0)
+    b, s, h, d = 2, 16, 4, 8
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    lengths = np.array([11, 7])
+    mask = jnp.asarray(np.arange(s)[None, :] < lengths[:, None])
+
+    causal = jnp.tril(jnp.ones((s, s), dtype=jnp.bool_))
+    dense = _attend(q, k, v, mask[:, None, :] & causal[None, :, :])
+
+    mesh = make_mesh({"sp": 4}, devices=cpu_devices[:4])
+    ring = ring_attention(q, k, v, mesh, causal=True, kv_mask=mask)
+    # compare only valid query rows (pad-row outputs are garbage by design)
+    for row, n in enumerate(lengths):
+        np.testing.assert_allclose(np.asarray(dense)[row, :n],
+                                   np.asarray(ring)[row, :n],
+                                   rtol=1e-5, atol=1e-5)
