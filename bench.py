@@ -184,16 +184,19 @@ def main() -> int:
     # wedge->fallback path on CPU with it)
     force = os.environ.get("LAMBDIPY_BENCH_FORCE_PLATFORM")
     attempts = [("device", {"LAMBDIPY_PLATFORM": force} if force else {})]
-    if force or os.environ.get("LAMBDIPY_PLATFORM") != "cpu":
+    # an explicit LAMBDIPY_PLATFORM pin is honored: no silent fallback to a
+    # different platform than the operator asked to measure
+    if force or not os.environ.get("LAMBDIPY_PLATFORM"):
         attempts.append(("cpu", {"LAMBDIPY_PLATFORM": "cpu"}))
     stages_log: dict[str, str] = {}
     for label, extra_env in attempts:
         env = dict(base_env)
         env.update(extra_env)
         env["LAMBDIPY_BENCH_ATTEMPT"] = label
+        platform = env.get("LAMBDIPY_PLATFORM") or "device"
         result = None
         for stage in STAGES:
-            data, err = _run_stage(stage, env, label)
+            data, err = _run_stage(stage, env, platform)
             if err is not None:
                 stages_log[f"{label}.{stage}"] = err
                 break

@@ -62,12 +62,54 @@ def _state_dict_of(source) -> tuple[dict, dict | None]:
     return dict(source), None
 
 
+def _rope_scaling_from_hf(hf_cfg: dict) -> tuple | None:
+    """HF ``rope_scaling`` dict -> our hashable tuple form; raises for
+    schemes the model does not implement (yarn, dynamic, longrope)."""
+    rs = hf_cfg.get("rope_scaling")
+    if not rs:
+        return None
+    kind = rs.get("rope_type", rs.get("type", "default"))
+    if kind in (None, "default"):
+        return None
+    if kind == "linear":
+        return ("linear", float(rs["factor"]))
+    if kind == "llama3":
+        return ("llama3", float(rs["factor"]),
+                float(rs["low_freq_factor"]), float(rs["high_freq_factor"]),
+                float(rs["original_max_position_embeddings"]))
+    raise ValueError(
+        f"unsupported HF config field: rope_scaling type {kind!r} "
+        "(supported: default, linear, llama3)")
+
+
+def _check_supported_hf_config(hf_cfg: dict) -> None:
+    """Reject HF config fields that would silently change numerics if
+    dropped (VERDICT r2 missing #6): wrong logits with no error is the
+    worst failure mode on the advertised migration path."""
+    if hf_cfg.get("attention_bias"):
+        raise ValueError(
+            "unsupported HF config field: attention_bias=True "
+            "(q/k/v/o projection biases are not implemented)")
+    if hf_cfg.get("mlp_bias"):
+        raise ValueError(
+            "unsupported HF config field: mlp_bias=True "
+            "(gate/up/down projection biases are not implemented)")
+    head_dim = hf_cfg.get("head_dim")
+    derived = int(hf_cfg["hidden_size"]) // int(hf_cfg["num_attention_heads"])
+    if head_dim is not None and int(head_dim) != derived:
+        raise ValueError(
+            f"unsupported HF config field: head_dim={head_dim} differs from "
+            f"hidden_size/num_attention_heads={derived}")
+
+
 def llama_config_from_hf(hf_cfg: dict, **overrides):
-    """Map an HF LlamaConfig dict onto our LlamaConfig."""
+    """Map an HF LlamaConfig dict onto our LlamaConfig; raises a clear
+    error for unsupported fields instead of silently dropping them."""
     from lambdipy_tpu.models.llama import LlamaConfig
 
     import jax.numpy as jnp
 
+    _check_supported_hf_config(hf_cfg)
     cfg = LlamaConfig(
         vocab_size=int(hf_cfg["vocab_size"]),
         hidden=int(hf_cfg["hidden_size"]),
@@ -78,6 +120,7 @@ def llama_config_from_hf(hf_cfg: dict, **overrides):
         mlp=int(hf_cfg["intermediate_size"]),
         max_len=int(hf_cfg.get("max_position_embeddings", 8192)),
         rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+        rope_scaling=_rope_scaling_from_hf(hf_cfg),
         norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
         dtype=jnp.bfloat16,
     )
@@ -252,5 +295,7 @@ def save_hf_params(hf_path: str | Path, params_dir: Path, *,
                        "layers": cfg.layers, "heads": cfg.heads,
                        "kv_heads": cfg.kv_heads, "mlp": cfg.mlp,
                        "rope_theta": cfg.rope_theta,
+                       "rope_scaling": (list(cfg.rope_scaling)
+                                        if cfg.rope_scaling else None),
                        "norm_eps": cfg.norm_eps, "max_len": cfg.max_len}}
     return info

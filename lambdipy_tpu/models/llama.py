@@ -35,6 +35,12 @@ class LlamaConfig:
     mlp: int = 14336
     max_len: int = 8192
     rope_theta: float = 500000.0
+    # RoPE frequency scaling for long-context checkpoints, as a hashable
+    # tuple (the config is a flax module attribute): None,
+    # ("linear", factor), or ("llama3", factor, low_freq_factor,
+    # high_freq_factor, original_max_position_embeddings) — the Llama-3.1+
+    # scheme. Populated from HF configs by models/convert.py.
+    rope_scaling: tuple | None = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     quant: str | None = None  # None | "int8"
@@ -122,10 +128,33 @@ class QDense(nn.Module):
         return x.astype(self.dtype) @ w
 
 
-def rope(q, k, positions, theta: float):
+def _scaled_rope_freqs(freqs, scaling):
+    """Apply RoPE frequency scaling (inverse frequencies in, out).
+
+    "llama3" is the Llama-3.1 scheme: low-frequency (long-wavelength)
+    components are slowed by ``factor``, high-frequency ones kept, with a
+    smooth ramp between the two wavelength thresholds derived from the
+    original context length."""
+    if scaling is None:
+        return freqs
+    kind = scaling[0]
+    if kind == "linear":
+        return freqs / jnp.float32(scaling[1])
+    if kind == "llama3":
+        factor, low_f, high_f, orig = map(float, scaling[1:])
+        wavelen = 2.0 * jnp.pi / freqs
+        smooth = (orig / wavelen - low_f) / (high_f - low_f)
+        mid = (1.0 - smooth) * freqs / factor + smooth * freqs
+        return jnp.where(wavelen > orig / low_f, freqs / factor,
+                         jnp.where(wavelen < orig / high_f, freqs, mid))
+    raise ValueError(f"unsupported rope scaling kind {kind!r}")
+
+
+def rope(q, k, positions, theta: float, scaling: tuple | None = None):
     """Rotary position embeddings, fp32 trig, applied per head-dim pair."""
     head_dim = q.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = _scaled_rope_freqs(freqs, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, hd/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -210,7 +239,7 @@ class LlamaBlock(nn.Module):
         q = q.reshape(b, s, cfg.heads, d)
         k = k.reshape(b, s, cfg.kv_heads, d)
         v = v.reshape(b, s, cfg.kv_heads, d)
-        q, k = rope(q, k, positions, cfg.rope_theta)
+        q, k = rope(q, k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         if cache is None:
             out = self._prefill_attend(q, k, v, mask)

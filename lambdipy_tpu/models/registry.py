@@ -299,7 +299,11 @@ def _build_llama_hf(dtype: str = "bfloat16", quant: str | None = None,
 
     from lambdipy_tpu.models.llama import LlamaConfig
 
-    extra = extra or {}
+    extra = dict(extra or {})
+    # manifest JSON round-trips the rope_scaling tuple as a list; the
+    # config field must be hashable (flax module attribute)
+    if extra.get("rope_scaling"):
+        extra["rope_scaling"] = tuple(extra["rope_scaling"])
     fields = {f.name for f in dataclasses.fields(LlamaConfig)}
     cfg = LlamaConfig(dtype=_dtype(dtype), quant=quant, **{
         k: v for k, v in extra.items() if k in fields - {"dtype", "quant"}})

@@ -33,8 +33,10 @@ class TrainerConfig:
     aux_weight: float = 0.01
     # optimizer stack (train/step.py make_optimizer): global-norm clipping,
     # warmup / cosine decay, gradient accumulation (total_steps counts
-    # micro-steps; params update every accum_steps-th step)
-    grad_clip: float | None = 1.0
+    # micro-steps; params update every accum_steps-th step). All default
+    # OFF: the defaults must keep the plain-adamw opt_state structure so
+    # checkpoints written before these knobs existed still exact-resume.
+    grad_clip: float | None = None
     warmup_steps: int = 0
     schedule: str = "constant"  # "constant" | "cosine"
     weight_decay: float = 0.0
