@@ -54,6 +54,7 @@ class LlamaConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_group_size: int = 256  # routing-group size (models/moe.py)
     # int8 matmul backend: "xla" (dequant fused by XLA, works under TP
     # sharding) or "pallas" (ops/quant.py blocked kernel — single-chip
     # serving; falls back per-matmul when shapes don't tile).
@@ -264,7 +265,7 @@ class LlamaBlock(nn.Module):
 
             x = x + MoEMLP(cfg.moe_experts, cfg.mlp, cfg.moe_top_k,
                            cfg.moe_capacity_factor, cfg.dtype, cfg.quant,
-                           name="moe")(h)
+                           group_size=cfg.moe_group_size, name="moe")(h)
         else:
             gate = QDense(cfg.mlp, cfg.quant, cfg.dtype, cfg.matmul_backend, name="gate_proj")(h)
             up = QDense(cfg.mlp, cfg.quant, cfg.dtype, cfg.matmul_backend, name="up_proj")(h)

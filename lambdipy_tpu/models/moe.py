@@ -30,18 +30,22 @@ from flax import linen as nn
 
 
 
-def route_topk(probs, top_k: int, capacity: int):
+def route_topk(probs, top_k: int, capacity: int, valid=None):
     """GShard-style top-k routing with a static per-expert capacity.
 
     probs: [t, e] fp32 router probabilities. Returns
     (dispatch [t, e, c] {0,1}, combine [t, e, c] fp32, aux_loss scalar).
     Slot priority: all tokens' first choices are seated before any second
     choice, so a token's top expert is the last to drop it on overflow.
+    ``valid``: optional [t] bool — invalid (padding) tokens are never
+    seated and are excluded from the balance loss.
     """
     t, e = probs.shape
     gates, idx = jax.lax.top_k(probs, top_k)  # [t, k]
     gates = gates / jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
     onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [t, k, e]
+    if valid is not None:
+        onehot = onehot * valid.astype(jnp.float32)[:, None, None]
 
     # accumulate per slot (static tiny top_k loop) so peak memory stays at
     # the [t, e, c] of the result tensors instead of top_k times that
@@ -62,8 +66,11 @@ def route_topk(probs, top_k: int, capacity: int):
 
     # Switch-Transformer load-balance loss: E * <frac tokens per expert> ·
     # <mean router prob per expert>; minimized at uniform routing
-    frac_tokens = jnp.mean(onehot[:, 0, :], axis=0)  # first-choice assignment
-    mean_probs = jnp.mean(probs, axis=0)
+    w = (jnp.ones((t,), jnp.float32) if valid is None
+         else valid.astype(jnp.float32))
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    frac_tokens = jnp.sum(onehot[:, 0, :], axis=0) / n  # first-choice assignment
+    mean_probs = jnp.sum(probs * w[:, None], axis=0) / n
     aux = e * jnp.sum(frac_tokens * mean_probs)
     return dispatch, combine, aux
 
@@ -84,6 +91,11 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
     quant: str | None = None
+    # Routing-group size (GShard): tokens route within fixed-size groups,
+    # so per-group capacity is a CONSTANT and the dispatch/combine tensors
+    # are [g, gs, e, c] — linear in total tokens, not the O(t^2) of a
+    # single global group whose capacity grows with t.
+    group_size: int = 256
 
     def _expert_weight(self, name: str, shape):
         if self.quant == "int8":
@@ -108,13 +120,29 @@ class MoEMLP(nn.Module):
         e, m = self.num_experts, self.mlp
         tokens = x.reshape(b * s, hidden)
         t = tokens.shape[0]
-        capacity = max(1, int(self.capacity_factor * self.top_k * t / e))
+        gs = min(t, self.group_size)
+        g = -(-t // gs)
+        pad = g * gs - t
+        capacity = max(1, int(self.capacity_factor * self.top_k * gs / e))
 
         router = self.param("router", nn.initializers.lecun_normal(),
                             (hidden, e), jnp.float32)
         probs = jax.nn.softmax(tokens.astype(jnp.float32) @ router, axis=-1)
-        dispatch, combine, aux = route_topk(probs, self.top_k, capacity)
-        self.sow("intermediates", "moe_aux_loss", aux)
+        valid = jnp.ones((t,), jnp.bool_)
+        if pad:
+            tokens = jnp.pad(tokens, ((0, pad), (0, 0)))
+            probs = jnp.pad(probs, ((0, pad), (0, 0)))
+            valid = jnp.pad(valid, (0, pad))
+        vg = valid.reshape(g, gs)
+        dispatch, combine, aux = jax.vmap(
+            lambda p, v: route_topk(p, self.top_k, capacity, valid=v))(
+                probs.reshape(g, gs, e), vg)
+        # combine per-group balance losses weighted by valid-token count —
+        # an unweighted mean would let a mostly-padding tail group's few
+        # tokens dominate the gradient
+        n_g = jnp.sum(vg.astype(jnp.float32), axis=-1)
+        self.sow("intermediates", "moe_aux_loss",
+                 jnp.sum(aux * n_g) / jnp.maximum(jnp.sum(n_g), 1.0))
 
         w_gate = self._expert_weight("experts_gate", (e, hidden, m))
         w_up = self._expert_weight("experts_up", (e, hidden, m))
@@ -122,16 +150,18 @@ class MoEMLP(nn.Module):
 
         from lambdipy_tpu.parallel.sharding import shard_hint
 
-        # dispatch all-to-all: tokens (dp-sharded) -> expert shards (ep)
-        xe = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype),
-                        tokens.astype(self.dtype))
-        xe = shard_hint(xe, "ep")
-        gate = jnp.einsum("ech,ehm->ecm", xe, w_gate)
-        up = jnp.einsum("ech,ehm->ecm", xe, w_up)
-        ye = jnp.einsum("ecm,emh->ech", nn.silu(gate) * up, w_down)
-        ye = shard_hint(ye, "ep")
+        # dispatch all-to-all: token groups (dp-sharded) -> expert shards
+        # (ep); [g, e, c, h] with c constant per group => linear in tokens
+        xe = jnp.einsum("gtec,gth->gech", dispatch.astype(self.dtype),
+                        tokens.reshape(g, gs, hidden).astype(self.dtype))
+        xe = shard_hint(xe, None, "ep")
+        gate = jnp.einsum("gech,ehm->gecm", xe, w_gate)
+        up = jnp.einsum("gech,ehm->gecm", xe, w_up)
+        ye = jnp.einsum("gecm,emh->gech", nn.silu(gate) * up, w_down)
+        ye = shard_hint(ye, None, "ep")
         # combine all-to-all back to token order, weighted by router gates
-        out = jnp.einsum("tec,ech->th", combine.astype(self.dtype), ye)
+        out = jnp.einsum("gtec,gech->gth", combine.astype(self.dtype), ye)
+        out = out.reshape(g * gs, hidden)[:t]
         return out.reshape(b, s, hidden).astype(x.dtype)
 
 
