@@ -54,6 +54,17 @@ def write_manifest(bundle_dir: Path, *, artifact_id: str, provenance: dict,
     return manifest
 
 
+def update_manifest(bundle_dir: Path, **fields) -> dict:
+    """Merge top-level fields into an existing manifest (e.g. the build-time
+    ``warm`` record, written after assembly). The file table is not
+    re-computed — it never includes the manifest itself."""
+    manifest = load_manifest(bundle_dir)
+    manifest.update(fields)
+    atomic_write_text(Path(bundle_dir) / MANIFEST_NAME,
+                      json.dumps(manifest, indent=1, sort_keys=True))
+    return manifest
+
+
 def load_manifest(bundle_dir: Path) -> dict:
     path = Path(bundle_dir) / MANIFEST_NAME
     if not path.exists():

@@ -132,11 +132,30 @@ def _run_build(recipe, registry, *, out=None, no_smoke=False, no_payload=False,
             click.echo(f"warning: warm timed out after {warm_timeout:.0f}s "
                        f"(device wedged?); bundle still usable", err=True)
             proc = None
+        # the warm outcome is part of the bundle's record, not just a
+        # build-log line: a failed warm means the bundle pays its first
+        # compile at boot, and downstream (deploy, healthz) must see that
         if proc is not None and proc.returncode == 0:
-            click.echo(f"warmed: {proc.stdout.strip().splitlines()[-1]}")
+            lines = proc.stdout.strip().splitlines()
+            last = lines[-1] if lines else ""
+            click.echo(f"warmed: {last}")
+            warm_record = {"ok": True}
+            try:
+                parsed = json.loads(last)
+                if isinstance(parsed, dict):
+                    warm_record.update(parsed)
+            except ValueError:
+                pass
         elif proc is not None:
             click.echo(f"warning: warm failed (bundle still usable): "
                        f"{proc.stderr.strip()[-300:]}", err=True)
+            warm_record = {"ok": False, "error": proc.stderr.strip()[-300:]}
+        else:
+            warm_record = {"ok": False,
+                           "error": f"timeout after {warm_timeout:.0f}s"}
+        from lambdipy_tpu.bundle.format import update_manifest
+
+        manifest = update_manifest(bundle_dir, warm=warm_record)
     if out is None:
         registry.publish(artifact_id, bundle_dir, recipe=recipe.name,
                          version=recipe.version, device=recipe.device,

@@ -86,6 +86,18 @@ class LocalRuntime:
         state = self._load()
         if name in state:
             raise DeployError(f"deployment {name!r} already exists; stop it first")
+        # surface a failed build-time warm before paying for it: this boot
+        # will trace+compile from scratch instead of hitting the cache
+        try:
+            from lambdipy_tpu.bundle.format import load_manifest
+
+            warm_info = load_manifest(bundle_dir).get("warm")
+            if isinstance(warm_info, dict) and not warm_info.get("ok"):
+                log_event(log, "bundle warm step failed at build time; expect "
+                               "a cold first compile", name=name,
+                          warm_error=warm_info.get("error", ""))
+        except Exception:
+            pass  # advisory only — never blocks a deploy
         module = ("lambdipy_tpu.runtime.supervisor" if watchdog
                   else "lambdipy_tpu.runtime.server")
         cmd = [sys.executable, "-m", module, str(bundle_dir), str(port)]

@@ -108,8 +108,10 @@ def _jax_adapter_and_params(spec: dict, ctx):
 
 
 def _aot_or_jit(ctx, fn, example_args, mesh):
-    """Single-chip path boots from the bundle's AOT store (runtime/aot.py);
-    meshed programs depend on the live device set, so they always re-jit.
+    """Boot from the bundle's AOT store (runtime/aot.py). Single-chip
+    payloads get both tiers; meshed payloads get the StableHLO tier keyed
+    by (topology, mesh shape), so a multi-device boot stops re-tracing
+    once any boot on the same topology has saved it.
 
     AOT artifacts are shape-specialized to the spec's example batch, so a
     hit is wrapped with a shape dispatch: example-shaped requests (the hot
@@ -118,11 +120,9 @@ def _aot_or_jit(ctx, fn, example_args, mesh):
     """
     import jax
 
-    if mesh is not None:
-        return jax.jit(fn), "jit"
     from lambdipy_tpu.runtime.aot import cached_jit
 
-    cached, src = cached_jit(ctx, "forward", fn, example_args)
+    cached, src = cached_jit(ctx, "forward", fn, example_args, mesh=mesh)
     if src == "jit":
         return cached, src
     fallback = jax.jit(fn)

@@ -50,6 +50,7 @@ class BootReport:
     stages: dict[str, float] = field(default_factory=dict)
     skew: dict = field(default_factory=dict)
     warmup_result: Any = None
+    manifest: dict = field(default_factory=dict)
 
     def cold_start_s(self) -> float:
         return sum(self.stages.values())
@@ -130,6 +131,7 @@ def load_bundle(bundle_dir: Path, *, warmup: bool = True) -> BootReport:
         stages=timer.report(),
         skew=skew,
         warmup_result=warmup_result,
+        manifest=manifest,
     )
     log_event(log, "bundle booted", bundle=str(bundle_dir),
               cold_start=report.stages, skew=bool(skew))

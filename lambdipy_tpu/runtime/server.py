@@ -73,6 +73,9 @@ class BundleServer:
                         "cold_start": server_self.boot.stages,
                         "skew": server_self.boot.skew,
                         "handler_meta": getattr(server_self.boot.state, "meta", {}),
+                        # build-time warm outcome from the manifest: a
+                        # failed warm explains a slow cold_start downstream
+                        "warm": server_self.boot.manifest.get("warm"),
                     })
                 elif self.path == "/metrics":
                     self._send(200, server_self.stats.report())

@@ -80,3 +80,28 @@ def test_deploy_rejects_unknown_target(tmp_path):
                                   "--registry", str(tmp_path / "reg")])
     assert r.exit_code != 0
     assert "neither a bundle dir" in r.output
+
+
+def test_build_records_warm_outcome_in_manifest(tiny_recipe_dir, tmp_path,
+                                                monkeypatch):
+    """The warm step's outcome is part of the bundle record (VERDICT r2
+    weak #5: a failed warm previously shipped silently)."""
+    out = tmp_path / "bundle"
+    r = CliRunner().invoke(main, [
+        "build", "tiny-llm", "--recipe-dir", str(tiny_recipe_dir),
+        "--out", str(out)])
+    assert r.exit_code == 0, r.output
+    manifest = json.loads((out / "manifest.json").read_text())
+    assert manifest["warm"]["ok"] is True
+    assert manifest["warm"]["cache_entries"] > 0
+
+    # simulated wedge: the warm subprocess times out -> recorded, not silent
+    monkeypatch.setenv("LAMBDIPY_WARM_TIMEOUT", "0.01")
+    out2 = tmp_path / "bundle2"
+    r2 = CliRunner().invoke(main, [
+        "build", "tiny-llm", "--recipe-dir", str(tiny_recipe_dir),
+        "--out", str(out2)])
+    assert r2.exit_code == 0, r2.output
+    manifest2 = json.loads((out2 / "manifest.json").read_text())
+    assert manifest2["warm"]["ok"] is False
+    assert "timeout" in manifest2["warm"]["error"]
