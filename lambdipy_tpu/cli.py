@@ -271,6 +271,7 @@ def publish_cmd(recipe_names, publish_all, release_store, tag, registry_dir,
     releases = _require_store(release_store)
     registry = ArtifactRegistry(registry_dir)
     tag = tag or f"v{__version__}"
+    failed: list[str] = []
     for name in names:
         recipe = store.get(name)
         if _pyver() not in recipe.python:
@@ -278,7 +279,14 @@ def publish_cmd(recipe_names, publish_all, release_store, tag, registry_dir,
             continue
         artifact_id = recipe.artifact_id(_pyver())
         if rebuild or not registry.has(artifact_id):
-            _run_build(recipe, registry, warm=warm)
+            try:
+                _run_build(recipe, registry, warm=warm)
+            except Exception as e:
+                # one unbuildable recipe (e.g. numpy-src without
+                # meson-python) must not abort the whole publish sweep
+                click.echo(f"FAILED {name}: {e}", err=True)
+                failed.append(name)
+                continue
         bundle = registry.fetch(artifact_id)
         with _tempfile.TemporaryDirectory(prefix="lambdipy-publish-") as td:
             archive = pack_bundle(bundle, Path(td) / f"{artifact_id}.tar.gz")
@@ -290,6 +298,9 @@ def publish_cmd(recipe_names, publish_all, release_store, tag, registry_dir,
                 raise click.ClickException(str(e)) from e
         click.echo(f"published {asset.name} ({asset.size / 1e6:.1f}MB) "
                    f"-> release {tag}")
+    if failed:
+        raise click.ClickException(
+            f"{len(failed)} recipe(s) failed to build: {', '.join(failed)}")
 
 
 @main.command("fetch")
