@@ -51,6 +51,9 @@ class BootReport:
     skew: dict = field(default_factory=dict)
     warmup_result: Any = None
     manifest: dict = field(default_factory=dict)
+    # active numerics-sanitizer flags (utils/debug.py apply_debug_env);
+    # non-empty means every jit call pays a device sync
+    debug_flags: dict = field(default_factory=dict)
 
     def cold_start_s(self) -> float:
         return sum(self.stages.values())
@@ -100,8 +103,15 @@ def load_bundle(bundle_dir: Path, *, warmup: bool = True) -> BootReport:
             uses_jax = model_registry.get(payload.get("model", "")).kind == "jax"
         except Exception:
             uses_jax = False
+        debug_flags = {}
         if uses_jax:
             attach_compile_cache(bundle_dir)
+            from lambdipy_tpu.utils.debug import apply_debug_env
+
+            # opt-in numerics sanitizer (LAMBDIPY_DEBUG_NANS=1 in the
+            # deployment env): NaN/Inf in any jit output raises at the
+            # producing primitive instead of poisoning responses
+            debug_flags = apply_debug_env()
 
     with timer.stage("handler_import"):
         spec = importlib.util.spec_from_file_location(
@@ -132,6 +142,7 @@ def load_bundle(bundle_dir: Path, *, warmup: bool = True) -> BootReport:
         skew=skew,
         warmup_result=warmup_result,
         manifest=manifest,
+        debug_flags=debug_flags,
     )
     log_event(log, "bundle booted", bundle=str(bundle_dir),
               cold_start=report.stages, skew=bool(skew))

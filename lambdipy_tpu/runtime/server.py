@@ -76,6 +76,8 @@ class BundleServer:
                         # build-time warm outcome from the manifest: a
                         # failed warm explains a slow cold_start downstream
                         "warm": server_self.boot.manifest.get("warm"),
+                        # non-empty = numerics sanitizer on (per-call sync)
+                        "debug_flags": server_self.boot.debug_flags,
                     })
                 elif self.path == "/metrics":
                     self._send(200, server_self.stats.report())

@@ -41,6 +41,10 @@ class TrainerConfig:
     schedule: str = "constant"  # "constant" | "cosine"
     weight_decay: float = 0.0
     accum_steps: int = 1
+    # numerics sanitizer (utils/debug.py): NaN in any step output raises
+    # FloatingPointError at the producing primitive. Debug only — forces
+    # a device sync per step.
+    debug_numerics: bool = False
 
 
 @dataclass
@@ -105,6 +109,15 @@ class Trainer:
 
     def run(self) -> TrainerReport:
         """Train until ``cfg.total_steps`` (absolute, resume-aware)."""
+        import contextlib
+
+        from lambdipy_tpu.utils.debug import debug_numerics
+
+        with (debug_numerics() if self.cfg.debug_numerics
+              else contextlib.nullcontext()):
+            return self._run()
+
+    def _run(self) -> TrainerReport:
         jax = self._jax
         start = self.step
         history: list[dict] = []

@@ -1,0 +1,68 @@
+"""Numerics debug checks (SURVEY.md §6 sanitizer row).
+
+The reference is a single-threaded CLI with nothing to sanitize; the
+rebuild's analog of a sanitizer is device-side numerics checking: jax's
+``debug_nans``/``debug_infs`` modes re-run the offending computation
+op-by-op when a NaN/Inf appears in a jit output and raise
+``FloatingPointError`` at the producing primitive — the XLA equivalent of
+"stop at the first bad write" instead of debugging a poisoned loss ten
+steps later.
+
+Two entry points:
+- :func:`debug_numerics` — scoped context manager for tests and the
+  Trainer (``TrainerConfig.debug_numerics=True``);
+- :func:`apply_debug_env` — process-level switch for the serve runtime
+  (``LAMBDIPY_DEBUG_NANS=1`` / ``LAMBDIPY_DEBUG_INFS=1`` in a
+  deployment's env), applied at bundle boot.
+
+The checks force a device sync per jit call, so they are a debug mode,
+never a default.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from lambdipy_tpu.utils.logs import get_logger
+
+log = get_logger("lambdipy.debug")
+
+
+@contextlib.contextmanager
+def debug_numerics(nans: bool = True, infs: bool = False):
+    """Enable NaN (and optionally Inf) checking for the enclosed scope;
+    prior flag values are restored on exit."""
+    import jax
+
+    prior = (jax.config.jax_debug_nans, jax.config.jax_debug_infs)
+    jax.config.update("jax_debug_nans", nans)
+    jax.config.update("jax_debug_infs", infs)
+    # executables compiled before the flag flip can keep serving through
+    # the jit fastpath WITHOUT the nan check (observed after meshed
+    # workloads); a debug mode can afford the re-trace
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prior[0])
+        jax.config.update("jax_debug_infs", prior[1])
+
+
+def apply_debug_env() -> dict:
+    """Apply LAMBDIPY_DEBUG_NANS / LAMBDIPY_DEBUG_INFS to the process.
+    Returns the flags applied (for boot reports)."""
+    import jax
+
+    flags = {}
+    if os.environ.get("LAMBDIPY_DEBUG_NANS") == "1":
+        jax.config.update("jax_debug_nans", True)
+        flags["debug_nans"] = True
+    if os.environ.get("LAMBDIPY_DEBUG_INFS") == "1":
+        jax.config.update("jax_debug_infs", True)
+        flags["debug_infs"] = True
+    if flags:
+        jax.clear_caches()  # see debug_numerics: pre-flip executables
+        log.warning("numerics debug mode active: %s (per-call device sync; "
+                    "not for production serving)", flags)
+    return flags

@@ -203,3 +203,24 @@ def test_server_boundary_matches_exact_decode(tiny_llama):
         max_new_tokens=20, max_len=128))
     np.testing.assert_array_equal(
         ref, server.generate(prompt, max_new_tokens=20))
+
+
+def test_server_serves_sharded_params_on_mesh(cpu_devices):
+    """LlamaServer over a tp mesh: compile-once serving works with
+    tensor-parallel sharded params (the config-5 serving shape)."""
+    from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+    from lambdipy_tpu.parallel.sharding import shard_params
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    ref_server = adapter.make_server(params)
+    ref = ref_server.generate([5, 6, 7, 8], max_new_tokens=6)
+
+    mesh = make_mesh({"tp": 2}, devices=cpu_devices[:2])
+    with use_mesh(mesh):
+        sharded = shard_params(params, mesh, adapter.tp_rules)
+    server = adapter.make_server(sharded, mesh=mesh)
+    out = server.generate([5, 6, 7, 8], max_new_tokens=6)
+    np.testing.assert_array_equal(ref, out)
+    server.generate([1, 2], max_new_tokens=4, temperature=0.8, seed=3)
+    assert server.compile_count == 1
