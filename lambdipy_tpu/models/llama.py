@@ -246,10 +246,17 @@ class LlamaBlock(nn.Module):
             out = self._prefill_attend(q, k, v, mask)
             new_cache = {"k": k, "v": v}
         else:
-            # decode: append this step's k/v at cache index, attend over prefix
+            from lambdipy_tpu.parallel.sharding import shard_hint
+
+            # decode: append this step's k/v at cache index, attend over
+            # prefix. The cache stays kv-head-sharded over tp across the
+            # scan — the dominant serving HBM object must never be
+            # gathered per step
             idx = cache["index"]  # scalar int32
             ck = jax.lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
             cv = jax.lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
+            ck = shard_hint(ck, "dp", None, "tp")
+            cv = shard_hint(cv, "dp", None, "tp")
             t = ck.shape[1]
             valid = jnp.arange(t)[None, :] <= idx  # [1, t]
             attn_mask = jnp.broadcast_to(valid[:, None, :], (b, s, t))

@@ -103,15 +103,17 @@ def load_bundle(bundle_dir: Path, *, warmup: bool = True) -> BootReport:
             uses_jax = model_registry.get(payload.get("model", "")).kind == "jax"
         except Exception:
             uses_jax = False
-        debug_flags = {}
         if uses_jax:
             attach_compile_cache(bundle_dir)
-            from lambdipy_tpu.utils.debug import apply_debug_env
+        from lambdipy_tpu.utils.debug import apply_debug_env
 
-            # opt-in numerics sanitizer (LAMBDIPY_DEBUG_NANS=1 in the
-            # deployment env): NaN/Inf in any jit output raises at the
-            # producing primitive instead of poisoning responses
-            debug_flags = apply_debug_env()
+        # opt-in numerics sanitizer (LAMBDIPY_DEBUG_NANS=1 in the
+        # deployment env): NaN/Inf in any jit output raises at the
+        # producing primitive instead of poisoning responses. Applied
+        # regardless of the registry-derived uses_jax flag — a custom
+        # handler may use jax directly; without the env vars it is a
+        # jax-free no-op
+        debug_flags = apply_debug_env()
 
     with timer.stage("handler_import"):
         spec = importlib.util.spec_from_file_location(

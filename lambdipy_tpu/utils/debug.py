@@ -30,14 +30,18 @@ log = get_logger("lambdipy.debug")
 
 
 @contextlib.contextmanager
-def debug_numerics(nans: bool = True, infs: bool = False):
+def debug_numerics(nans: bool | None = True, infs: bool | None = None):
     """Enable NaN (and optionally Inf) checking for the enclosed scope;
-    prior flag values are restored on exit."""
+    prior flag values are restored on exit. ``None`` leaves a flag at its
+    current value — the context must never silently WEAKEN checking that
+    an outer scope (or the env switch) already enabled."""
     import jax
 
     prior = (jax.config.jax_debug_nans, jax.config.jax_debug_infs)
-    jax.config.update("jax_debug_nans", nans)
-    jax.config.update("jax_debug_infs", infs)
+    if nans is not None:
+        jax.config.update("jax_debug_nans", nans)
+    if infs is not None:
+        jax.config.update("jax_debug_infs", infs)
     # executables compiled before the flag flip can keep serving through
     # the jit fastpath WITHOUT the nan check (observed after meshed
     # workloads); a debug mode can afford the re-trace
@@ -51,17 +55,22 @@ def debug_numerics(nans: bool = True, infs: bool = False):
 
 def apply_debug_env() -> dict:
     """Apply LAMBDIPY_DEBUG_NANS / LAMBDIPY_DEBUG_INFS to the process.
-    Returns the flags applied (for boot reports)."""
-    import jax
-
+    Returns the flags applied (for boot reports). Cheap no-op (jax never
+    imported) when neither env var is set, so callers can invoke it
+    unconditionally — including for bundles whose payload model is not a
+    registered jax family but whose handler uses jax directly."""
     flags = {}
     if os.environ.get("LAMBDIPY_DEBUG_NANS") == "1":
-        jax.config.update("jax_debug_nans", True)
         flags["debug_nans"] = True
     if os.environ.get("LAMBDIPY_DEBUG_INFS") == "1":
-        jax.config.update("jax_debug_infs", True)
         flags["debug_infs"] = True
     if flags:
+        import jax
+
+        if flags.get("debug_nans"):
+            jax.config.update("jax_debug_nans", True)
+        if flags.get("debug_infs"):
+            jax.config.update("jax_debug_infs", True)
         jax.clear_caches()  # see debug_numerics: pre-flip executables
         log.warning("numerics debug mode active: %s (per-call device sync; "
                     "not for production serving)", flags)
