@@ -321,7 +321,8 @@ def _build_llama_moe_tiny(dtype: str = "float32", quant: str | None = None,
     cfg = dataclasses.replace(
         LLAMA_TINY, dtype=_dtype(dtype), quant=quant,
         moe_experts=int(extra.get("moe_experts", 4)),
-        moe_top_k=int(extra.get("moe_top_k", 2)))
+        moe_top_k=int(extra.get("moe_top_k", 2)),
+        moe_capacity_factor=float(extra.get("moe_capacity_factor", 1.25)))
     return _build_llama(cfg)
 
 

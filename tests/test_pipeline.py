@@ -150,3 +150,29 @@ def test_llama_pipeline_forward_composes_with_dp(cpu_devices):
                                num_microbatches=2)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_forward_with_moe_blocks(cpu_devices):
+    """MoE blocks trace inside the pipeline's manual region: expert
+    sharding hints are suppressed there (no whole-mesh constraints inside
+    shard_map) and the pp forward still matches the dense forward.
+
+    Ample capacity, deliberately: GShard routing competes for capacity
+    within whatever batch it sees, so under capacity pressure a
+    microbatched forward legitimately drops different tokens than the
+    full-batch one — parity is only defined when nothing overflows."""
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.models.llama import pipeline_forward
+
+    adapter = registry.get("llama-moe-tiny").build(
+        extra={"moe_capacity_factor": 8.0})
+    params = adapter.init_params(seed=0)
+    tokens = jnp.asarray(np.random.default_rng(9).integers(0, 500, (4, 8)),
+                         jnp.int32)
+    ref = adapter.forward(params, tokens)
+    mesh = make_mesh({"pp": 2}, devices=cpu_devices[:2])
+    with mesh:
+        out = pipeline_forward(adapter.module, params, tokens, mesh,
+                               num_microbatches=2)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-4, atol=1e-4)
