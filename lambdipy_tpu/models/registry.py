@@ -226,6 +226,34 @@ def _llama_tp_rules():
     ))
 
 
+_ATTN_BACKENDS = ("dense", "flash", "ring")
+_MATMUL_BACKENDS = ("xla", "pallas")
+
+
+def _llama_overrides(extra: dict | None) -> dict:
+    """Filter ``extra`` down to LlamaConfig fields and validate the backend
+    knobs — a misspelled backend must raise, not silently fall back to the
+    default path while the user benchmarks the wrong thing."""
+    import dataclasses
+
+    from lambdipy_tpu.models.llama import LlamaConfig
+
+    extra = dict(extra or {})
+    # manifest JSON round-trips the rope_scaling tuple as a list; the
+    # config field must be hashable (flax module attribute)
+    if extra.get("rope_scaling"):
+        extra["rope_scaling"] = tuple(extra["rope_scaling"])
+    fields = {f.name for f in dataclasses.fields(LlamaConfig)}
+    out = {k: v for k, v in extra.items() if k in fields - {"dtype", "quant"}}
+    if out.get("attn_backend", "dense") not in _ATTN_BACKENDS:
+        raise ValueError(f"unknown attn_backend {out['attn_backend']!r}; "
+                         f"supported: {_ATTN_BACKENDS}")
+    if out.get("matmul_backend", "xla") not in _MATMUL_BACKENDS:
+        raise ValueError(f"unknown matmul_backend {out['matmul_backend']!r}; "
+                         f"supported: {_MATMUL_BACKENDS}")
+    return out
+
+
 def _build_llama(cfg) -> JaxModel:
     import jax.numpy as jnp
 
@@ -282,10 +310,8 @@ def _build_llama3_8b(dtype: str = "bfloat16", quant: str | None = "int8",
 
     from lambdipy_tpu.models.llama import LLAMA3_8B
 
-    extra = extra or {}
-    cfg = dataclasses.replace(
-        LLAMA3_8B, dtype=_dtype(dtype), quant=quant,
-        max_len=int(extra.get("max_len", 8192)))
+    cfg = dataclasses.replace(LLAMA3_8B, dtype=_dtype(dtype), quant=quant,
+                              **_llama_overrides(extra))
     return _build_llama(cfg)
 
 
@@ -299,14 +325,8 @@ def _build_llama_hf(dtype: str = "bfloat16", quant: str | None = None,
 
     from lambdipy_tpu.models.llama import LlamaConfig
 
-    extra = dict(extra or {})
-    # manifest JSON round-trips the rope_scaling tuple as a list; the
-    # config field must be hashable (flax module attribute)
-    if extra.get("rope_scaling"):
-        extra["rope_scaling"] = tuple(extra["rope_scaling"])
-    fields = {f.name for f in dataclasses.fields(LlamaConfig)}
-    cfg = LlamaConfig(dtype=_dtype(dtype), quant=quant, **{
-        k: v for k, v in extra.items() if k in fields - {"dtype", "quant"}})
+    cfg = LlamaConfig(dtype=_dtype(dtype), quant=quant,
+                      **_llama_overrides(extra))
     return _build_llama(cfg)
 
 

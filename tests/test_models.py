@@ -151,3 +151,28 @@ def test_torch_bert_cpu_smoke(tmp_path):
         out = model(torch.zeros(2, 16, dtype=torch.long),
                     torch.ones(2, 16, dtype=torch.long))
     assert out.shape == (2, 2)
+
+
+def test_llama3_8b_builder_plumbs_backends():
+    """Recipe extras select the prefill-attention and int8-matmul backends
+    for the config-5 model without touching model code."""
+    from lambdipy_tpu.models import registry
+
+    spec = registry.get("llama3-8b")
+    cfg = spec.build(extra={"attn_backend": "flash",
+                            "matmul_backend": "pallas",
+                            "max_len": 4096}).config
+    assert cfg.attn_backend == "flash"
+    assert cfg.matmul_backend == "pallas"
+    assert cfg.max_len == 4096 and cfg.quant == "int8"
+
+
+def test_llama_builder_rejects_unknown_backend():
+    import pytest as _pytest
+
+    from lambdipy_tpu.models import registry
+
+    with _pytest.raises(ValueError, match="attn_backend"):
+        registry.get("llama3-8b").build(extra={"attn_backend": "Flash"})
+    with _pytest.raises(ValueError, match="matmul_backend"):
+        registry.get("llama-hf").build(extra={"matmul_backend": "cuda"})
