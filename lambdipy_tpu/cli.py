@@ -471,6 +471,87 @@ def deployments_cmd():
         click.echo(f"{dep.name:25s} pid={dep.pid:<8d} {dep.url}")
 
 
+@main.command("doctor")
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+@click.option("--state", "state_path", type=click.Path(), default=None,
+              help="deployments state file (default: ~/.lambdipy-tpu)")
+@click.option("--probe-timeout", default=90.0, show_default=True,
+              help="seconds before the device probe is declared wedged")
+def doctor_cmd(registry_dir, state_path, probe_timeout):
+    """Environment diagnostics: stack versions, device reachability (the
+    TPU transport can wedge indefinitely — the probe is a subprocess with
+    a timeout, never an in-process jax.devices()), registry and
+    deployment health. Prints one JSON object; exit 1 if the device probe
+    fails while the shell is configured for a device platform."""
+    import importlib.metadata as md
+    import os
+    import subprocess
+
+    from lambdipy_tpu.resolve.registry import ArtifactRegistry
+    from lambdipy_tpu.runtime.deploy import LocalRuntime
+
+    report: dict = {"python": sys.version.split()[0]}
+    report["packages"] = {}
+    for pkg in ("jax", "jaxlib", "libtpu", "flax", "optax", "orbax-checkpoint"):
+        try:
+            report["packages"][pkg] = md.version(pkg)
+        except md.PackageNotFoundError:
+            report["packages"][pkg] = None
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import os, jax\n"
+             "p = os.environ.get('LAMBDIPY_PLATFORM')\n"
+             "jax.config.update('jax_platforms', p) if p else None\n"
+             "d = jax.devices()\n"
+             "print('DOCTOR', d[0].platform, len(d))"],
+            capture_output=True, text=True, timeout=probe_timeout)
+        # parse only our marker line: sitecustomize/plugins may write
+        # banners to the child's stdout
+        marker = [ln for ln in proc.stdout.splitlines()
+                  if ln.startswith("DOCTOR ")]
+        if proc.returncode == 0 and marker:
+            _, platform, n = marker[-1].split()
+            report["device"] = {"ok": True, "platform": platform,
+                                "n_devices": int(n)}
+        else:
+            report["device"] = {"ok": False,
+                                "error": proc.stderr.strip()[-300:]}
+    except subprocess.TimeoutExpired:
+        report["device"] = {
+            "ok": False,
+            "error": f"wedge: device enumeration hung for {probe_timeout:.0f}s "
+                     "(transport down? another process holding the device?)"}
+
+    try:
+        arts = ArtifactRegistry(registry_dir).list()
+        report["registry"] = {"artifacts": len(arts),
+                              "bytes": sum(a.size_bytes for a in arts)}
+    except Exception as e:
+        report["registry"] = {"error": str(e)}
+    deployments = []
+    try:
+        rt = LocalRuntime(Path(state_path) if state_path else None)
+        for dep in rt.list():
+            entry = {"name": dep.name, "url": dep.url}
+            try:
+                entry["healthy"] = bool(rt.health(dep.name).get("ok"))
+            except Exception as e:
+                entry["healthy"] = False
+                entry["error"] = str(e)[:120]
+            deployments.append(entry)
+    except Exception as e:
+        deployments = [{"error": str(e)[:120]}]
+    report["deployments"] = deployments
+
+    click.echo(json.dumps(report, indent=1))
+    effective = (os.environ.get("LAMBDIPY_PLATFORM")
+                 or os.environ.get("JAX_PLATFORMS", ""))
+    if not report["device"]["ok"] and effective not in ("", "cpu"):
+        raise SystemExit(1)
+
+
 @main.command("train")
 @click.option("--model", "model_name", default="llama-tiny",
               help="registry model (llama-tiny / llama3-8b / llama-moe-tiny ...)")

@@ -105,3 +105,32 @@ def test_build_records_warm_outcome_in_manifest(tiny_recipe_dir, tmp_path,
     manifest2 = json.loads((out2 / "manifest.json").read_text())
     assert manifest2["warm"]["ok"] is False
     assert "timeout" in manifest2["warm"]["error"]
+
+
+def test_doctor_reports_environment(tmp_path, monkeypatch):
+    monkeypatch.setenv("LAMBDIPY_PLATFORM", "cpu")
+    r = CliRunner().invoke(main, [
+        "doctor", "--probe-timeout", "60",
+        "--registry", str(tmp_path / "reg"),
+        "--state", str(tmp_path / "deployments.json")])
+    assert r.exit_code == 0, r.output
+    doc = json.loads(r.output)
+    assert doc["packages"]["jax"] and doc["packages"]["libtpu"]
+    assert doc["device"]["ok"] is True and doc["device"]["platform"] == "cpu"
+    assert doc["registry"]["artifacts"] == 0
+    assert doc["deployments"] == []
+
+
+def test_doctor_diagnoses_wedged_device(tmp_path, monkeypatch):
+    """A hung device probe is reported as a wedge with a nonzero exit, not
+    an indefinite hang (the axon transport has done exactly this)."""
+    monkeypatch.delenv("LAMBDIPY_PLATFORM", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    r = CliRunner().invoke(main, [
+        "doctor", "--probe-timeout", "1",
+        "--registry", str(tmp_path / "reg"),
+        "--state", str(tmp_path / "deployments.json")])
+    doc = json.loads(r.output)
+    assert doc["device"]["ok"] is False
+    assert "wedge" in doc["device"]["error"]
+    assert r.exit_code == 1
