@@ -498,15 +498,23 @@ def doctor_cmd(registry_dir, state_path, probe_timeout):
         except md.PackageNotFoundError:
             report["packages"][pkg] = None
 
+    probe_env = dict(os.environ)
+    repo_root = str(Path(__file__).resolve().parents[1])
+    probe_env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + [p for p in probe_env.get("PYTHONPATH", "").split(os.pathsep) if p])
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
-             "import os, jax\n"
-             "p = os.environ.get('LAMBDIPY_PLATFORM')\n"
-             "jax.config.update('jax_platforms', p) if p else None\n"
+             # the one place LAMBDIPY_PLATFORM is honored is the shared
+             # helper — the probe must diagnose the same environment the
+             # real entry points run in
+             "from lambdipy_tpu.utils.platform import apply_platform_override\n"
+             "apply_platform_override()\n"
+             "import jax\n"
              "d = jax.devices()\n"
              "print('DOCTOR', d[0].platform, len(d))"],
-            capture_output=True, text=True, timeout=probe_timeout)
+            capture_output=True, text=True, env=probe_env,
+            timeout=probe_timeout)
         # parse only our marker line: sitecustomize/plugins may write
         # banners to the child's stdout
         marker = [ln for ln in proc.stdout.splitlines()

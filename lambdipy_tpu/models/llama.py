@@ -578,8 +578,14 @@ class LlamaServer:
         self._fns: dict[tuple[int, int], Any] = {}
 
     @property
+    def buckets(self) -> list[tuple[int, int]]:
+        """Snapshot of the (prompt, decode) bucket keys compiled so far
+        (safe against concurrent inserts from another serving thread)."""
+        return sorted(self._fns)
+
+    @property
     def compile_count(self) -> int:
-        return sum(fn._cache_size() for fn in self._fns.values())
+        return sum(fn._cache_size() for fn in list(self._fns.values()))
 
     def _compiled(self, sb: int, steps: int):
         key = (sb, steps)

@@ -22,12 +22,23 @@ from typing import Any, Callable
 class HandlerState:
     invoke_fn: Callable[[dict], dict]
     meta: dict
+    # optional live-stats provider merged into /metrics (e.g. the decode
+    # server's bucket/compile counters); must be cheap and non-blocking
+    stats_fn: Callable[[], dict] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
         out = self.invoke_fn(dict(request or {}))
         out.setdefault("latency_ms", round((time.monotonic() - t0) * 1e3, 3))
         return out
+
+    def stats(self) -> dict:
+        if self.stats_fn is None:
+            return {}
+        try:
+            return self.stats_fn()
+        except Exception:  # stats must never break the metrics endpoint
+            return {}
 
 
 # --------------------------------------------------------------------------
@@ -340,9 +351,16 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             out["completion"] = tokenizer.decode(row)
         return out
 
-    return HandlerState(invoke_fn=invoke, meta={
+    def stats() -> dict:
+        if server is None:
+            return {}
+        return {"decode_buckets": [list(b) for b in server.buckets],
+                "compile_count": server.compile_count}
+
+    return HandlerState(invoke_fn=invoke, stats_fn=stats, meta={
         "model": spec["model"], "quant": spec.get("quant"),
         "sharded": mesh is not None, "tokenizer": tokenizer is not None,
+        "compile_once": server is not None,
         **({"tokenizer_error": tok_err} if tok_err else {}),
     })
 

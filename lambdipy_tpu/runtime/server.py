@@ -80,7 +80,12 @@ class BundleServer:
                         "debug_flags": server_self.boot.debug_flags,
                     })
                 elif self.path == "/metrics":
-                    self._send(200, server_self.stats.report())
+                    report = server_self.stats.report()
+                    handler_stats = getattr(server_self.boot.state, "stats",
+                                            lambda: {})()
+                    if handler_stats:
+                        report["handler"] = handler_stats
+                    self._send(200, report)
                 else:
                     self._send(404, {"ok": False, "error": "not found"})
 

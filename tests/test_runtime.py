@@ -107,6 +107,9 @@ def test_http_server_full_loop(llama_bundle):
         assert out["ok"] and out["n_new"] == 2
         metrics = _get(f"{base}/metrics")
         assert metrics["count"] >= 1 and metrics["p50_ms"] > 0
+        # the decode server's live counters surface through /metrics
+        assert metrics["handler"]["compile_count"] >= 1
+        assert metrics["handler"]["decode_buckets"]
         # failure detection: bad payload shape -> 500, counted, server alive
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(f"{base}/invoke", {"tokens": "not-a-list"})
