@@ -252,14 +252,22 @@ class LlamaBlock(nn.Module):
             # prefix. The cache stays kv-head-sharded over tp across the
             # scan — the dominant serving HBM object must never be
             # gathered per step
-            idx = cache["index"]  # scalar int32
-            ck = jax.lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
+            idx = cache["index"]  # int32 scalar, or [b] per-row positions
+            if jnp.ndim(idx) == 0:
+                ck = jax.lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
+                valid = jnp.arange(ck.shape[1])[None, :] <= idx  # [1, t]
+            else:
+                # ragged batch (rows decode from different prompt lengths):
+                # per-row scatter of this step's single position
+                assert s == 1, "per-row cache indices require one-token steps"
+                rows = jnp.arange(b)
+                ck = cache["k"].at[rows, idx].set(k[:, 0])
+                cv = cache["v"].at[rows, idx].set(v[:, 0])
+                valid = jnp.arange(ck.shape[1])[None, :] <= idx[:, None]  # [b, t]
             ck = shard_hint(ck, "dp", None, "tp")
             cv = shard_hint(cv, "dp", None, "tp")
-            t = ck.shape[1]
-            valid = jnp.arange(t)[None, :] <= idx  # [1, t]
-            attn_mask = jnp.broadcast_to(valid[:, None, :], (b, s, t))
+            attn_mask = jnp.broadcast_to(valid[:, None, :], (b, s, ck.shape[1]))
             out = _attend(q, ck, cv, attn_mask)
             new_cache = {"k": ck, "v": cv}
 
@@ -490,8 +498,9 @@ def _scan_decode(model: LlamaModel, params, select_fn, first, cache, start,
     has_eos = eos_id >= 0
 
     def step(carry, _):
-        tok, cache, pos, done, rng = carry
-        positions = jnp.broadcast_to(pos[None, None], (b, 1))
+        tok, cache, pos, done, rng = carry  # pos: int32 scalar or [b]
+        positions = (pos[:, None] if jnp.ndim(pos)
+                     else jnp.broadcast_to(pos[None, None], (b, 1)))
         logits, new_cache = model.apply(params, tok[:, None],
                                         positions=positions, cache=cache)
         for entry in new_cache:
@@ -513,11 +522,13 @@ def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
     """Serving decode with every request knob as a runtime operand.
 
     prompt: [b, sb] int32, right-padded to the bucket size sb; length:
-    int32 scalar, the true common prompt length. Right padding is safe
-    under causal attention — real positions never attend pad keys, and the
-    decode loop overwrites each pad cache slot at index ``length + j``
-    before the validity mask (``pos <= index``) ever exposes it. The first
-    sampled token reads the logits at ``length - 1``, not at ``sb - 1``.
+    int32 scalar or [b] — PER-ROW true prompt lengths, so one program
+    serves a ragged batch of different-length prompts (each row decodes
+    from its own prompt end). Right padding is safe under causal
+    attention — real positions never attend pad keys, and the decode loop
+    overwrites each row's pad cache slots at index ``length[r] + j``
+    before the validity mask (``pos <= index``) ever exposes them. The
+    first sampled token reads row r's logits at ``length[r] - 1``.
 
     temperature (f32, <= 0 = greedy), top_k (int32, <= 0 = off), top_p
     (f32, >= 1 = off), eos_id (int32, < 0 = none) and the PRNG key are all
@@ -526,12 +537,15 @@ def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
     """
     cfg = model.cfg
     b, sb = prompt.shape
-    length = jnp.asarray(length, jnp.int32)
+    length = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (b,))
     logits, prefill_cache = model.apply(params, prompt)
     cache = prefill_into_cache(cfg, prefill_cache, b, cache_len, 0)
     for entry in cache:
         entry["index"] = length
-    last = jax.lax.dynamic_slice_in_dim(logits, length - 1, 1, axis=1)[:, 0, :]
+    v = logits.shape[-1]
+    last = jnp.take_along_axis(
+        logits, jnp.broadcast_to((length - 1)[:, None, None], (b, 1, v)),
+        axis=1)[:, 0, :]
 
     def select(lg, rng):
         lg = lg.astype(jnp.float32)
@@ -559,10 +573,12 @@ class LlamaServer:
     """Compile-once decode serving: prompt-length bucketing (pad right to a
     power of two) + sampling knobs as runtime operands.
 
-    One jitted ``_serve_decode`` per (prompt-bucket, decode-bucket) pair
-    serves every request that falls in it; a second request with a
+    One jitted ``_serve_decode`` per (batch, prompt-bucket, decode-bucket)
+    triple serves every request that falls in it; a second request with a
     different prompt length, temperature, top-k/p, seed, or eos triggers
-    ZERO new compiles (VERDICT r2 #3). ``compile_count`` exposes the
+    ZERO new compiles (VERDICT r2 #3). Ragged batches are first-class:
+    per-row length operands let rows of different prompt lengths decode
+    together, each from its own prompt end. ``compile_count`` exposes the
     number of distinct compiled programs for tests and metrics.
     """
 
@@ -575,20 +591,20 @@ class LlamaServer:
         # default: anything the context window allows is servable (power-
         # of-two bucketing bounds distinct compiles at log2(max_len))
         self.decode_cap = decode_cap or model.cfg.max_len
-        self._fns: dict[tuple[int, int], Any] = {}
+        self._fns: dict[tuple[int, int, int], Any] = {}
 
     @property
-    def buckets(self) -> list[tuple[int, int]]:
-        """Snapshot of the (prompt, decode) bucket keys compiled so far
-        (safe against concurrent inserts from another serving thread)."""
+    def buckets(self) -> list[tuple[int, int, int]]:
+        """Snapshot of the (batch, prompt, decode) bucket keys compiled so
+        far (safe against concurrent inserts from another serving thread)."""
         return sorted(self._fns)
 
     @property
     def compile_count(self) -> int:
         return sum(fn._cache_size() for fn in list(self._fns.values()))
 
-    def _compiled(self, sb: int, steps: int):
-        key = (sb, steps)
+    def _compiled(self, b: int, sb: int, steps: int):
+        key = (b, sb, steps)
         if key not in self._fns:
             cache_len = min(sb + steps, self.model.cfg.max_len)
 
@@ -606,16 +622,14 @@ class LlamaServer:
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, seed: int = 0,
                  eos_id: int | None = None):
-        """prompt_tokens: [s] or [b, s] int array -> [b, max_new_tokens]."""
+        """prompt_tokens: [s], [b, s], or a RAGGED list of rows with
+        different lengths (each row decodes from its own prompt end) ->
+        [b, max_new_tokens]."""
         import numpy as np
 
         cfg = self.model.cfg
-        ids = np.asarray(prompt_tokens, np.int32)
-        if ids.ndim == 1:
-            ids = ids[None, :]
-        b, s = ids.shape
-        if s < 1:
-            raise ValueError("empty prompt")
+        rows, lengths = self._normalize_prompts(prompt_tokens)
+        b, s = len(rows), max(lengths)
         if max_new_tokens < 0:
             raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
         if max_new_tokens > self.decode_cap:
@@ -632,10 +646,16 @@ class LlamaServer:
         steps = min(_next_bucket(max_new_tokens, self.min_bucket),
                     self.decode_cap, cfg.max_len - s)
         sb = min(_next_bucket(s, self.min_bucket), cfg.max_len - steps)
-        padded = np.zeros((b, sb), np.int32)
-        padded[:, :s] = ids
-        fn = self._compiled(sb, steps)
-        args = (self.params, jnp.asarray(padded), jnp.int32(s),
+        # batch is bucketed too (micro-batching produces nondeterministic
+        # sizes; each distinct b would otherwise compile at request time);
+        # dummy length-1 rows are free under per-row lengths
+        bb = _next_bucket(b, 1)
+        padded = np.zeros((bb, sb), np.int32)
+        for r, row in enumerate(rows):
+            padded[r, :lengths[r]] = row
+        fn = self._compiled(bb, sb, steps)
+        args = (self.params, jnp.asarray(padded),
+                jnp.asarray(lengths + [1] * (bb - b), jnp.int32),
                 jnp.float32(temperature if temperature is not None else 0.0),
                 jnp.int32(top_k if top_k is not None else 0),
                 jnp.float32(top_p if top_p is not None else 1.0),
@@ -648,7 +668,22 @@ class LlamaServer:
                 out = fn(*args)
         else:
             out = fn(*args)
-        return np.asarray(jax.device_get(out))[:, :max_new_tokens]
+        return np.asarray(jax.device_get(out))[:b, :max_new_tokens]
+
+    @staticmethod
+    def _normalize_prompts(prompt_tokens):
+        """-> (list of 1-D int32 row arrays, list of true lengths)."""
+        import numpy as np
+
+        if isinstance(prompt_tokens, (list, tuple)) and prompt_tokens and \
+                isinstance(prompt_tokens[0], (list, tuple, np.ndarray)):
+            rows = [np.asarray(r, np.int32).reshape(-1) for r in prompt_tokens]
+        else:
+            ids = np.asarray(prompt_tokens, np.int32)
+            rows = list(ids[None, :] if ids.ndim == 1 else ids)
+        if not rows or any(len(r) < 1 for r in rows):
+            raise ValueError("empty prompt")
+        return rows, [len(r) for r in rows]
 
 
 def _decode(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,

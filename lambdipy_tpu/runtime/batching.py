@@ -1,0 +1,162 @@
+"""Server-side micro-batching for the generate handler.
+
+The HTTP server is threaded; under concurrent load, each request was
+dispatched to the device alone. The decode path supports RAGGED batches
+(per-row length operands, models/llama.py LlamaServer), so concurrent
+requests with the same sampling knobs can share one device program:
+batch-1 decode is HBM-bandwidth-bound on TPU (every step re-reads all
+weights), so b rows decode in nearly the time of one — near-linear
+throughput until the MXU saturates.
+
+Protocol: the first thread to arrive becomes the leader, sleeps one
+collection window while followers queue, then drains every compatible
+pending request with ITS knob key (temperature/top-k/p/seed/eos must
+match — they are shared operands of the fused call) into one ragged
+``server.generate``. After every batch the condition variable wakes all
+waiters: finished requests return, and the current queue head's own
+thread drains the next group — each thread serves at most the batches
+its own request rides on, so no thread is conscripted into serving the
+queue forever, and no key composition can strand a request. Greedy
+results are bitwise identical to solo serving (per-row parity is
+tested). Sampled (temperature > 0) requests bypass the queue and run
+solo: a fused categorical draws per row index, which would make a
+request's tokens depend on concurrent traffic and break what ``seed``
+promises.
+
+Opt-in per bundle: ``[payload.extra] batch_window_ms = 2`` (0 = off).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from lambdipy_tpu.utils.logs import get_logger
+
+log = get_logger("lambdipy.batching")
+
+
+class MicroBatcher:
+    """Group concurrent single-row generate calls into ragged batches."""
+
+    def __init__(self, server: Any, *, window_ms: float = 2.0,
+                 max_batch: int = 8):
+        self.server = server
+        self.window_s = max(0.0, window_ms) / 1e3
+        self.max_batch = max(1, max_batch)
+        self._cond = threading.Condition()
+        self._pending: list[dict] = []
+        self.batches_run = 0
+        self.rows_served = 0
+
+    # -- internals ----------------------------------------------------------
+
+    def _drain_locked(self, key) -> list[dict]:
+        """Take pending same-key entries that can legally FUSE: the fused
+        call pays max(prompt len) + max(max_new) and the shared decode
+        cap, so an entry valid solo may be incompatible with the forming
+        batch — it stays queued for a later batch rather than poisoning
+        this one. The head entry is always taken, alone if need be, so
+        its own (possibly invalid) request errors only to its caller."""
+        max_len = self.server.model.cfg.max_len
+        cap = self.server.decode_cap
+        batch: list[dict] = []
+        s_max = n_max = 0
+        for e in list(self._pending):
+            if len(batch) >= self.max_batch or e["key"] != key:
+                continue
+            s = max(s_max, len(e["row"]))
+            n = max(n_max, e["n"])
+            if batch and (s + n > max_len or n > cap):
+                continue
+            s_max, n_max = s, n
+            batch.append(e)
+            self._pending.remove(e)
+        return batch
+
+    def _run_one(self, batch: list[dict]) -> None:
+        if not batch:
+            return
+        temperature, top_k, top_p, seed, eos_id = batch[0]["key"]
+        try:
+            n = max(e["n"] for e in batch)
+            out = self.server.generate(
+                [e["row"] for e in batch], max_new_tokens=n,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, eos_id=eos_id)
+            for i, e in enumerate(batch):
+                e["result"] = out[i : i + 1, : e["n"]]
+        except Exception as ex:  # surfaces per-request, server stays up
+            for e in batch:
+                e["error"] = ex
+        with self._cond:
+            self.batches_run += 1
+            self.rows_served += len(batch)
+            for e in batch:
+                e["done"] = True
+            self._cond.notify_all()
+
+    def _serve_group(self, key) -> None:
+        with self._cond:
+            batch = self._drain_locked(key)
+        self._run_one(batch)
+
+    # -- API ----------------------------------------------------------------
+
+    def generate(self, prompt_row, *, max_new_tokens: int,
+                 temperature: float = 0.0, top_k=None, top_p=None,
+                 seed: int = 0, eos_id=None):
+        """One request row -> [1, max_new_tokens] (same contract as
+        ``server.generate`` on a single prompt)."""
+        # sampled requests run solo: a fused categorical draws per ROW
+        # INDEX from the shared key, so a row's tokens would depend on
+        # uncontrollable concurrent traffic and `seed` would silently stop
+        # meaning reproducibility. Greedy (the bulk of batchable serving
+        # load) is row-exact under fusion.
+        if self.window_s <= 0.0 or (temperature or 0.0) > 0.0:
+            return self.server.generate(
+                prompt_row, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, eos_id=eos_id)
+
+        key = (temperature, top_k, top_p, seed, eos_id)
+        entry = {"row": prompt_row, "n": max_new_tokens, "key": key,
+                 "done": False, "result": None, "error": None}
+        with self._cond:
+            self._pending.append(entry)
+            leader = len(self._pending) == 1
+            self._cond.notify_all()  # a collecting leader may now be full
+        if leader:
+            # collect for one window, waking early once no more same-key
+            # entries can fit anyway
+            deadline = time.monotonic() + self.window_s
+            with self._cond:
+                while (remaining := deadline - time.monotonic()) > 0:
+                    same = sum(1 for e in self._pending if e["key"] == key)
+                    if same >= self.max_batch:
+                        break
+                    self._cond.wait(timeout=remaining)
+            self._serve_group(key)
+        while True:
+            with self._cond:
+                if entry["done"]:
+                    break
+                if not (self._pending and self._pending[0] is entry):
+                    # another thread's batch is in flight (or its leader is
+                    # still collecting); the post-batch notify wakes us
+                    self._cond.wait(timeout=1.0)
+                    continue
+            # we are the queue head: serve our own key group now instead
+            # of waiting out a timeout (covers leader-overflow leftovers
+            # and key groups the previous batch didn't match)
+            self._serve_group(key)
+        if entry["error"] is not None:
+            raise entry["error"]
+        return entry["result"]
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"batches_run": self.batches_run,
+                    "rows_served": self.rows_served,
+                    "pending": len(self._pending)}

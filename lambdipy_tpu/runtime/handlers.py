@@ -273,10 +273,18 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     # compile-once serving: prompt-length bucketing + runtime sampling
     # knobs, one compiled program per shape bucket (llama.LlamaServer)
     server = None
+    batcher = None
     if adapter.make_server is not None:
         cap = extra.get("decode_cap")  # None = full context window
         server = adapter.make_server(
             params, mesh=mesh, decode_cap=int(cap) if cap else None)
+        window_ms = float(extra.get("batch_window_ms", 0) or 0)
+        if window_ms > 0:
+            from lambdipy_tpu.runtime.batching import MicroBatcher
+
+            # concurrent same-knob requests share one ragged device call
+            batcher = MicroBatcher(server, window_ms=window_ms,
+                                   max_batch=int(extra.get("batch_max", 8)))
 
     tokenizer, tok_err = None, None
     tok_path = (spec.get("extra") or {}).get("tokenizer_path")
@@ -298,20 +306,39 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             tok_err = str(e)
 
     def run(prompt, max_new, sample_kwargs):
+        # prompt stays a host numpy array until the chosen path needs it:
+        # the server/batcher convert internally, only the legacy
+        # adapter.generate path pays a device transfer here
+        if batcher is not None and prompt.shape[0] == 1:
+            return batcher.generate(prompt[0], max_new_tokens=max_new,
+                                    **sample_kwargs)
         if server is not None:
             return server.generate(prompt, max_new_tokens=max_new,
                                    **sample_kwargs)
+        device_prompt = jnp.asarray(prompt)
         if mesh is not None:
             with mesh:
-                return adapter.generate(params, prompt, max_new_tokens=max_new,
-                                        **sample_kwargs)
-        return adapter.generate(params, prompt, max_new_tokens=max_new,
+                return adapter.generate(params, device_prompt,
+                                        max_new_tokens=max_new, **sample_kwargs)
+        return adapter.generate(params, device_prompt, max_new_tokens=max_new,
                                 **sample_kwargs)
 
     def invoke(req: dict) -> dict:
         from_text = False
         if req.get("warmup") or req.get("random"):
-            prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+            if req.get("warmup") and server is not None and batcher is not None:
+                # pre-compile every batch-size bucket the micro-batcher can
+                # produce — including the bucket max_batch rounds UP to —
+                # so the first concurrent burst hits warm programs, not an
+                # inline XLA compile
+                from lambdipy_tpu.models.llama import _next_bucket
+
+                bb, top = 2, _next_bucket(batcher.max_batch, 1)
+                while bb <= top:
+                    server.generate([[1, 2, 3, 4]] * bb,
+                                    max_new_tokens=default_new)
+                    bb *= 2
+            prompt = np.asarray([[1, 2, 3, 4]], np.int32)
         elif req.get("text") is not None:
             if tokenizer is None:
                 return {"ok": False,
@@ -320,13 +347,13 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             if not ids:
                 return {"ok": False,
                         "error": "prompt tokenized to zero tokens"}
-            prompt = jnp.asarray([ids], jnp.int32)
+            prompt = np.asarray([ids], np.int32)
             from_text = True
         else:
             raw = np.asarray(req["tokens"], dtype=np.int32)
             if raw.size == 0:
                 return {"ok": False, "error": "empty prompt"}
-            prompt = jnp.asarray(raw[None, :] if raw.ndim == 1 else raw)
+            prompt = raw[None, :] if raw.ndim == 1 else raw
         # tolerate JSON null (= "use the default"); explicit 0 is honored
         raw_new = req.get("max_new_tokens")
         max_new = default_new if raw_new is None else int(raw_new)
@@ -354,8 +381,11 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     def stats() -> dict:
         if server is None:
             return {}
-        return {"decode_buckets": [list(b) for b in server.buckets],
-                "compile_count": server.compile_count}
+        out = {"decode_buckets": [list(b) for b in server.buckets],
+               "compile_count": server.compile_count}
+        if batcher is not None:
+            out["batching"] = batcher.stats()
+        return out
 
     return HandlerState(invoke_fn=invoke, stats_fn=stats, meta={
         "model": spec["model"], "quant": spec.get("quant"),

@@ -1,0 +1,250 @@
+"""Server-side micro-batching (runtime/batching.py): concurrent same-knob
+requests share one ragged device call; mismatched knobs never strand."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models import registry
+from lambdipy_tpu.runtime.batching import MicroBatcher
+
+
+@pytest.fixture(scope="module")
+def server():
+    adapter = registry.get("llama-tiny").build()
+    return adapter.make_server(adapter.init_params(seed=0))
+
+
+def _fire(fn_list):
+    results, errors = [None] * len(fn_list), [None] * len(fn_list)
+
+    def call(i):
+        try:
+            results[i] = fn_list[i]()
+        except Exception as e:  # noqa: BLE001 - surfaced by the assert
+            errors[i] = e
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(len(fn_list))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(errors), errors
+    return results
+
+
+def test_batched_greedy_matches_solo(server):
+    """Concurrent greedy requests produce exactly the solo results and run
+    as fewer device calls than requests."""
+    prompts = [[5, 6, 7, 8, 9], [1, 2, 3], [9, 8, 7, 6], [2, 4, 6, 8, 10, 12]]
+    solo = [server.generate(p, max_new_tokens=6) for p in prompts]
+
+    batcher = MicroBatcher(server, window_ms=150, max_batch=8)
+    results = _fire([
+        lambda p=p: batcher.generate(np.asarray(p, np.int32),
+                                     max_new_tokens=6)
+        for p in prompts])
+    for got, want in zip(results, solo):
+        np.testing.assert_array_equal(got, want)
+    stats = batcher.stats()
+    assert stats["rows_served"] == len(prompts)
+    assert stats["batches_run"] < len(prompts), stats  # actually batched
+    assert stats["pending"] == 0
+
+
+def test_mismatched_knobs_all_complete(server):
+    """Requests with different sampling knobs cannot share a device call;
+    every one must still complete (self-promotion, no stranding)."""
+    batcher = MicroBatcher(server, window_ms=50, max_batch=8)
+    calls = [
+        lambda: batcher.generate(np.asarray([5, 6, 7], np.int32),
+                                 max_new_tokens=4),
+        lambda: batcher.generate(np.asarray([1, 2], np.int32),
+                                 max_new_tokens=4, temperature=0.9, seed=1),
+        lambda: batcher.generate(np.asarray([8, 9], np.int32),
+                                 max_new_tokens=4, temperature=0.9, seed=2),
+        lambda: batcher.generate(np.asarray([3, 3, 3], np.int32),
+                                 max_new_tokens=4, top_k=None, eos_id=7),
+    ]
+    results = _fire(calls)
+    assert all(r.shape == (1, 4) for r in results)
+    assert batcher.stats()["pending"] == 0
+
+
+def test_mixed_max_new_sliced_per_request(server):
+    """Batched requests may ask for different token counts; each gets
+    exactly what it asked for."""
+    batcher = MicroBatcher(server, window_ms=150)
+    results = _fire([
+        lambda: batcher.generate(np.asarray([5, 6, 7], np.int32),
+                                 max_new_tokens=3),
+        lambda: batcher.generate(np.asarray([5, 6, 7], np.int32),
+                                 max_new_tokens=9),
+    ])
+    shapes = sorted(r.shape for r in results)
+    assert shapes == [(1, 3), (1, 9)]
+
+
+def test_window_zero_bypasses_queue(server):
+    batcher = MicroBatcher(server, window_ms=0)
+    out = batcher.generate(np.asarray([5, 6, 7], np.int32), max_new_tokens=4)
+    assert out.shape == (1, 4)
+    assert batcher.stats()["batches_run"] == 0  # direct path, no queue
+
+
+def test_error_surfaces_per_request(server):
+    """A failing request (overflow) raises in ITS caller; the batcher and
+    server stay healthy for the next request."""
+    batcher = MicroBatcher(server, window_ms=20)
+    with pytest.raises(ValueError):
+        batcher.generate(np.arange(1, 100, dtype=np.int32),
+                         max_new_tokens=120)
+    out = batcher.generate(np.asarray([5, 6, 7], np.int32), max_new_tokens=4)
+    assert out.shape == (1, 4)
+
+
+@pytest.mark.slow
+def test_http_concurrent_invokes_are_batched(tmp_path):
+    """Through the real bundle + threaded HTTP server: concurrent greedy
+    invokes share device calls; /metrics shows the batching counters."""
+    import json
+    import urllib.request
+
+    from tests.test_runtime import make_model_bundle
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "4", "batch_window_ms": "100"})
+    server = BundleServer(bundle, port=0).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(payload):
+        req = urllib.request.Request(
+            f"{base}/invoke", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    try:
+        post({"tokens": [1, 2, 3]})  # warm the bucket
+        results = _fire([
+            lambda i=i: post({"tokens": [1, 2, 3 + i]}) for i in range(4)])
+        assert all(r["ok"] and r["n_new"] == 4 for r in results)
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            metrics = json.loads(r.read())
+        batching = metrics["handler"]["batching"]
+        assert batching["rows_served"] >= 5
+        assert batching["batches_run"] < batching["rows_served"], batching
+    finally:
+        server.stop()
+
+
+def test_batcher_splits_incompatible_fusions(server):
+    """Two requests each valid solo but whose FUSED shape exceeds max_len
+    (llama-tiny: 128) are served in separate calls, both succeeding."""
+    long_prompt = list(range(1, 105))   # 104 + 20 = 124 <= 128 solo
+    calls = [
+        lambda: batcher.generate(np.asarray(long_prompt, np.int32),
+                                 max_new_tokens=20),
+        lambda: batcher.generate(np.asarray([1, 2, 3, 4], np.int32),
+                                 max_new_tokens=28),  # 4 + 28 solo ok
+    ]
+    batcher = MicroBatcher(server, window_ms=100)
+    results = _fire(calls)
+    shapes = sorted(r.shape for r in results)
+    assert shapes == [(1, 20), (1, 28)]
+    assert batcher.stats()["batches_run"] == 2  # could not fuse
+
+
+def test_batch_size_is_bucketed():
+    """Distinct concurrent batch sizes reuse pow-2-bucketed programs
+    instead of compiling per size."""
+    adapter = registry.get("llama-tiny").build()
+    fresh = adapter.make_server(adapter.init_params(seed=0))
+    fresh.generate([[1, 2], [3, 4], [5, 6]], max_new_tokens=4)   # b=3 -> 4
+    fresh.generate([[1, 2], [3, 4], [5, 6], [7, 8]], max_new_tokens=4)
+    assert fresh.compile_count == 1  # both hit the b=4 program
+    assert fresh.buckets == [(4, 16, 16)]
+
+
+def test_sustained_load_every_request_returns(server):
+    """Sustained back-to-back load: no thread gets conscripted into
+    serving the queue forever — every request returns promptly."""
+    batcher = MicroBatcher(server, window_ms=10, max_batch=4)
+    n_threads, per_thread = 4, 5
+    results = [[] for _ in range(n_threads)]
+    errors = []
+
+    def worker(i):
+        try:
+            for j in range(per_thread):
+                out = batcher.generate(
+                    np.asarray([1 + i, 2 + j, 3], np.int32), max_new_tokens=4)
+                results[i].append(out)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "a request never returned"
+    assert not errors, errors
+    assert all(len(r) == per_thread for r in results)
+    assert batcher.stats()["pending"] == 0
+
+
+def test_decode_cap_incompatibility_splits(server):
+    """A request whose max_new exceeds what the fused batch may use is
+    split out, not fused into a batch that the cap would reject."""
+    adapter = registry.get("llama-tiny").build()
+    capped = adapter.make_server(adapter.init_params(seed=0), decode_cap=16)
+    batcher = MicroBatcher(capped, window_ms=100)
+    results = _fire([
+        lambda: batcher.generate(np.asarray([1, 2, 3], np.int32),
+                                 max_new_tokens=4),
+        lambda: batcher.generate(np.asarray([4, 5, 6], np.int32),
+                                 max_new_tokens=16),
+    ])
+    shapes = sorted(r.shape for r in results)
+    assert shapes == [(1, 4), (1, 16)]
+
+
+def test_sampled_requests_stay_seed_deterministic(server):
+    """temperature>0 requests bypass fusion: the same (prompt, seed)
+    request returns identical tokens regardless of concurrent traffic."""
+    batcher = MicroBatcher(server, window_ms=50, max_batch=8)
+
+    def sampled():
+        return batcher.generate(np.asarray([5, 6, 7], np.int32),
+                                max_new_tokens=6, temperature=1.2, seed=42)
+
+    alone = sampled()
+    mixed = _fire([sampled] + [
+        lambda i=i: batcher.generate(np.asarray([1, 2, 3 + i], np.int32),
+                                     max_new_tokens=6)
+        for i in range(3)])
+    np.testing.assert_array_equal(alone, mixed[0])
+
+
+def test_full_batch_wakes_leader_early(server):
+    """With max_batch same-key requests already queued, the leader drains
+    without waiting out the (deliberately huge) window."""
+    import time as _time
+
+    batcher = MicroBatcher(server, window_ms=30_000, max_batch=2)
+    t0 = _time.monotonic()
+    results = _fire([
+        lambda: batcher.generate(np.asarray([5, 6, 7], np.int32),
+                                 max_new_tokens=4),
+        lambda: batcher.generate(np.asarray([1, 2], np.int32),
+                                 max_new_tokens=4),
+    ])
+    assert _time.monotonic() - t0 < 20, "leader slept out the full window"
+    assert all(r.shape == (1, 4) for r in results)

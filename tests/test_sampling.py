@@ -251,3 +251,31 @@ def test_server_int8_quantized_decoding(cpu_devices):
     np.testing.assert_array_equal(ref[:, :2], out[:, :2])
     qserver.generate([1, 2, 3], max_new_tokens=4, temperature=0.7, seed=1)
     assert qserver.compile_count == 1
+
+
+def test_server_ragged_batch_matches_individual_rows(tiny_llama):
+    """A ragged batch (rows of different prompt lengths) decodes each row
+    identically to serving that row alone — per-row length operands, not
+    one shared length."""
+    adapter, params = tiny_llama
+    server = adapter.make_server(params)
+    prompts = [[5, 6, 7, 8, 9, 10, 11], [3, 4, 5], [9, 8, 7, 6, 5]]
+    batch = server.generate(prompts, max_new_tokens=6)
+    assert batch.shape == (3, 6)
+    for row, prompt in enumerate(prompts):
+        solo = server.generate(prompt, max_new_tokens=6)
+        np.testing.assert_array_equal(batch[row], solo[0],
+                                      err_msg=f"row {row} diverged")
+
+
+def test_server_ragged_eos_per_row(tiny_llama):
+    """eos latching is per-row in a ragged batch."""
+    adapter, params = tiny_llama
+    server = adapter.make_server(params)
+    free0 = server.generate([5, 6, 7, 8], max_new_tokens=8)[0]
+    eos = int(free0[2])
+    out = server.generate([[5, 6, 7, 8], [1, 2]], max_new_tokens=8,
+                          eos_id=eos)
+    row0 = out[0]
+    np.testing.assert_array_equal(row0[:3], free0[:3])
+    assert (row0[np.where(row0 == eos)[0][0]:] == eos).all()
