@@ -285,8 +285,11 @@ def save_hf_params(hf_path: str | Path, params_dir: Path, *,
     ckptr = ocp.StandardCheckpointer()
     ckptr.save((params_dir / "orbax").resolve(), params)
     ckptr.wait_until_finished()
+    from lambdipy_tpu.bundle import flatpack
+
+    flatpack.save(params_dir / "params.fpk", params)
     n = sum(v.size for v in jax_tree_leaves(params))
-    info = {"format": "orbax", "n_params": int(n), "source": "hf",
+    info = {"format": "orbax+fpk", "n_params": int(n), "source": "hf",
             "hf_path": str(hf_path), "quant": quant,
             # the COMPLETE architecture: the serve side rebuilds the module
             # from exactly this dict, so every field that changes numerics

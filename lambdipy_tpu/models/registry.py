@@ -437,7 +437,13 @@ def save_init_params(model: str, params_dir: Path, *, dtype: str = "bfloat16",
         ckptr = ocp.StandardCheckpointer()
         ckptr.save((params_dir / "orbax").resolve(), params)
         ckptr.wait_until_finished()
-        info = {"format": "orbax", "n_params": int(n_params), "seed": seed}
+        # flat single-file mirror of the same tree: the boot path prefers
+        # it (~0.1 s mmap read vs ~3.6 s orbax restore on this 1-core
+        # host — a third of the cold-start budget; bundle/flatpack.py)
+        from lambdipy_tpu.bundle import flatpack
+
+        flatpack.save(params_dir / "params.fpk", params)
+        info = {"format": "orbax+fpk", "n_params": int(n_params), "seed": seed}
     elif spec.kind == "sklearn":
         import joblib
 
@@ -464,6 +470,11 @@ def load_params(model: str, params_dir: Path):
     spec = get(model)
     params_dir = Path(params_dir)
     if spec.kind == "jax":
+        fpk = params_dir / "params.fpk"
+        if fpk.is_file():
+            from lambdipy_tpu.bundle import flatpack
+
+            return flatpack.load(fpk)
         import orbax.checkpoint as ocp
 
         ckptr = ocp.StandardCheckpointer()

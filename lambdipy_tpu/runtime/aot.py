@@ -185,11 +185,14 @@ class AotStore:
                     fn = self._load_tier(tier, paths)
                     if fn is None:
                         continue
+                    # device_get, not block_until_ready: only a host fetch
+                    # observes real completion through the remote tunnel
+                    # (see _probe in load())
                     t0 = time.monotonic()
-                    jax.block_until_ready(fn(*example_args))
+                    jax.device_get(fn(*example_args))
                     first_ms = (time.monotonic() - t0) * 1000.0
                     t0 = time.monotonic()
-                    jax.block_until_ready(fn(*example_args))
+                    jax.device_get(fn(*example_args))
                     ms = (time.monotonic() - t0) * 1000.0
                 if ms > _MAX_CALL_MS:
                     log.warning(
@@ -257,32 +260,40 @@ class AotStore:
 
         def _probe(fn: Callable, tier: str) -> bool:
             """Correctness + latency gate. Raises on breakage; returns
-            False (and marks rejected_slow) on a gate failure. The steady
-            gate always uses a second call when the first is over budget;
-            the 4x short-circuit (cap the boot cost at one slow call)
-            applies only to the exec tier — an hlo tier's first call may
-            legitimately be a multi-second compile (e.g. the warm step
-            timed out and the bundle shipped without its cache entry)."""
+            False (and marks rejected_slow) on a gate failure.
+
+            Timing uses ``jax.device_get`` of the result, not
+            ``block_until_ready``: through the axon remote tunnel
+            block_until_ready returns at submission (~0.03 ms) while the
+            remote execution is still in flight — only a host fetch
+            observes real completion. The gate is on the SECOND
+            (steady-state) call: the first call of any tier legitimately
+            pays one-time remote program load (~4 s measured for the exec
+            tier) or remote compile, and doubles as the warmup. A tier
+            whose steady call re-crosses the tunnel every time (~3 s/call,
+            the failure this gate exists for) still fails."""
             if example_args is None:
                 return True
             import jax
 
             t0 = time.monotonic()
-            jax.block_until_ready(fn(*example_args))
+            jax.device_get(fn(*example_args))
+            first_ms = (time.monotonic() - t0) * 1000.0
+            t0 = time.monotonic()
+            jax.device_get(fn(*example_args))
             ms = (time.monotonic() - t0) * 1000.0
-            slow = tier == "exec" and ms > 4 * _MAX_CALL_MS
-            if not slow and ms > _MAX_CALL_MS:
-                t0 = time.monotonic()
-                jax.block_until_ready(fn(*example_args))
-                ms = (time.monotonic() - t0) * 1000.0
-                slow = ms > _MAX_CALL_MS
-            if slow:
+            if ms > _MAX_CALL_MS:
                 self.rejected_slow = True
                 log.warning(
-                    "aot %s: %s tier call %.0fms exceeds gate %.0fms; "
-                    "rejecting (plain jit + warm cache will serve)",
-                    name, tier, ms, _MAX_CALL_MS)
+                    "aot %s: %s tier steady call %.0fms (first %.0fms) "
+                    "exceeds gate %.0fms; rejecting (plain jit + warm "
+                    "cache will serve)", name, tier, ms, first_ms,
+                    _MAX_CALL_MS)
                 return False
+            if first_ms > _MAX_CALL_MS:
+                log.info("aot %s: %s tier first call %.0fms (one-time "
+                         "program load), steady %.0fms", name, tier,
+                         first_ms, ms)
             return True
 
         for tier in ("exec", "hlo"):

@@ -120,7 +120,12 @@ class MicroBatcher:
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 seed=seed, eos_id=eos_id)
 
-        key = (temperature, top_k, top_p, seed, eos_id)
+        # greedy decode is argmax: temperature (<= 0), top_k/top_p and seed
+        # are provably inert (llama._serve_decode select()), so normalize
+        # them out of the fuse key — clients that send a per-request seed
+        # with temperature=0 (a common pattern) must still batch together.
+        # eos_id stays: it is a live shared operand of the fused call.
+        key = (0.0, None, None, 0, eos_id)
         entry = {"row": prompt_row, "n": max_new_tokens, "key": key,
                  "done": False, "result": None, "error": None}
         with self._cond:

@@ -105,6 +105,24 @@ def load_bundle(bundle_dir: Path, *, warmup: bool = True) -> BootReport:
             uses_jax = False
         if uses_jax:
             attach_compile_cache(bundle_dir)
+            # start PJRT backend init NOW on a worker thread so the
+            # device attach (0.1-6.5 s measured through the axon tunnel,
+            # high variance) overlaps the handler import + params restore
+            # below instead of serializing in front of them. Backend init
+            # is lock-guarded inside jax; the handler's first device call
+            # simply joins it.
+            import threading
+
+            def _init_backend():
+                try:
+                    import jax
+
+                    jax.devices()
+                except Exception as e:  # surfaced again, with context, by
+                    log.warning("background PJRT init failed: %s", e)
+
+            threading.Thread(target=_init_backend, daemon=True,
+                             name="pjrt-init").start()
         from lambdipy_tpu.utils.debug import apply_debug_env
 
         # opt-in numerics sanitizer (LAMBDIPY_DEBUG_NANS=1 in the

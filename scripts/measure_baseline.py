@@ -43,6 +43,46 @@ CONFIGS = {
 }
 
 
+def measure_d2h_floor(timeout_s: float = 180.0) -> float | None:
+    """Median wall-clock ms to fetch a FRESH device result host-side.
+
+    On a locally attached chip this is sub-millisecond (PCIe). Through a
+    remote-tunnel PJRT plugin (the axon plugin this image uses) every
+    fetch of a not-yet-transferred buffer pays one network round trip —
+    measured ~66 ms here, independent of payload size down to a scalar,
+    while host->device stays sub-ms. That RTT is a property of the test
+    environment's transport, not of the serving stack: any synchronous
+    invoke whose response depends on device output is bounded below by
+    it. Recording the floor lets the device tests assert the north-star
+    budget on serve-path overhead NET of transport, which converges to
+    the plain end-to-end assertion on real hardware where the floor is
+    ~0. Returns None if the probe fails (no device / wedge).
+    """
+    code = (
+        "import json, statistics, time\n"
+        "import jax, jax.numpy as jnp\n"
+        "f = jax.jit(lambda x: (x * 2).sum())\n"
+        "x = jax.device_put(jnp.ones((8, 8), jnp.float32))\n"
+        "float(f(x))\n"
+        "ts = []\n"
+        "for _ in range(15):\n"
+        "    t = time.monotonic(); float(f(x))\n"
+        "    ts.append((time.monotonic() - t) * 1e3)\n"
+        "print(json.dumps({'d2h_ms': round(statistics.median(ts), 3)}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s,
+            env={k: v for k, v in os.environ.items()
+                 if k != "LAMBDIPY_PLATFORM"})
+        if proc.returncode != 0:
+            return None
+        return float(json.loads(proc.stdout.strip().splitlines()[-1])["d2h_ms"])
+    except (subprocess.TimeoutExpired, ValueError, KeyError, IndexError):
+        return None
+
+
 def tpu_reachable(timeout_s: float = 90.0) -> bool:
     """Probe the device in a subprocess — jax.devices() can wedge."""
     try:
@@ -58,8 +98,14 @@ def tpu_reachable(timeout_s: float = 90.0) -> bool:
 
 
 def measure_config(num: int, *, invokes: int = 30,
-                   work: Path | None = None) -> dict:
-    """Build + deploy + invoke one config; returns the measured record."""
+                   work: Path | None = None,
+                   d2h_floor: float | None = None) -> dict:
+    """Build + deploy + invoke one config; returns the measured record.
+
+    For device configs the record carries the environment's measured
+    ``d2h_rtt_ms`` transport floor (see :func:`measure_d2h_floor`) and
+    ``serve_overhead_p50_ms`` = p50 net of that floor — the number the
+    serving stack is actually accountable for."""
     from lambdipy_tpu.runtime.deploy import LocalRuntime
 
     cfg = CONFIGS[num]
@@ -96,6 +142,11 @@ def measure_config(num: int, *, invokes: int = 30,
             times.append((time.monotonic() - t) * 1000.0)
             assert out.get("ok"), out
         times.sort()
+        # the cold_start stage dict carries its own "total"; summing every
+        # value would double-count it against the component stages
+        cs = health["cold_start"]
+        cold_start_s = cs.get("total", sum(v for k, v in cs.items()
+                                           if k != "total"))
         record = {
             "recipe": cfg["recipe"],
             "platform": health.get("handler_meta", {}).get("platform",
@@ -103,13 +154,20 @@ def measure_config(num: int, *, invokes: int = 30,
             "invoke_p50_ms": round(statistics.median(times), 3),
             "invoke_p99_ms": round(times[min(len(times) - 1,
                                              int(len(times) * 0.99))], 3),
-            "cold_start_s": round(sum(health["cold_start"].values()), 2),
+            "cold_start_s": round(cold_start_s, 2),
             "deploy_wall_s": round(deploy_wall_s, 2),
             "build_s": round(build_s, 1),
             "n_invokes": invokes,
             "warm_ok": bool((health.get("warm") or {}).get("ok")),
             "measured_at": time.strftime("%Y-%m-%d"),
         }
+        if cfg["platform"] == "device":
+            if d2h_floor is None:
+                d2h_floor = measure_d2h_floor()
+            if d2h_floor is not None:
+                record["d2h_rtt_ms"] = round(d2h_floor, 3)
+                record["serve_overhead_p50_ms"] = round(
+                    max(0.0, record["invoke_p50_ms"] - d2h_floor), 3)
     finally:
         rt.stop(name)
     return record
@@ -140,9 +198,12 @@ def main() -> int:
             print("device unreachable; measuring CPU configs only",
                   file=sys.stderr)
     records = {}
+    d2h_floor = (measure_d2h_floor()
+                 if any(CONFIGS[n]["platform"] == "device" for n in nums)
+                 else None)
     for num in nums:
         print(f"config {num}: {CONFIGS[num]['recipe']} ...", file=sys.stderr)
-        rec = measure_config(num, invokes=args.invokes)
+        rec = measure_config(num, invokes=args.invokes, d2h_floor=d2h_floor)
         records[f"config{num}"] = rec
         print(json.dumps({f"config{num}": rec}))
     if records and not args.no_publish:

@@ -73,6 +73,26 @@ def test_mismatched_knobs_all_complete(server):
     assert batcher.stats()["pending"] == 0
 
 
+def test_greedy_fuses_across_inert_knobs(server):
+    """temperature=0 makes seed/top_k/top_p provably inert (argmax), so
+    requests differing only in those must share one device call — a
+    per-request random seed (a common client pattern) must not fragment
+    the batch into solo runs."""
+    prompts = [[5, 6, 7], [1, 2, 3], [9, 8, 7, 6], [2, 4, 6]]
+    solo = [server.generate(p, max_new_tokens=4) for p in prompts]
+    batcher = MicroBatcher(server, window_ms=150, max_batch=8)
+    results = _fire([
+        lambda i=i, p=p: batcher.generate(
+            np.asarray(p, np.int32), max_new_tokens=4, temperature=0.0,
+            seed=1000 + i, top_k=(None, 5, 17, None)[i],
+            top_p=(None, 0.9, None, 0.5)[i])
+        for i, p in enumerate(prompts)])
+    for got, want in zip(results, solo):
+        np.testing.assert_array_equal(got, want)
+    stats = batcher.stats()
+    assert stats["batches_run"] < len(prompts), stats  # actually fused
+
+
 def test_mixed_max_new_sliced_per_request(server):
     """Batched requests may ask for different token counts; each gets
     exactly what it asked for."""

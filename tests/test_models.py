@@ -126,11 +126,56 @@ def test_llama_tp_sharded_forward_matches_single_device(cpu_devices):
 
 def test_save_and_load_params_roundtrip_jax(tmp_path):
     info = registry.save_init_params("llama-tiny", tmp_path / "p", dtype="float32")
-    assert info["format"] == "orbax" and info["n_params"] > 0
+    assert info["format"] == "orbax+fpk" and info["n_params"] > 0
     params = registry.load_params("llama-tiny", tmp_path / "p")
     adapter = registry.get("llama-tiny").build()
     logits = adapter.forward(params, jnp.asarray([[1, 2]], jnp.int32))
     assert logits.shape[-1] == adapter.config.vocab_size
+
+
+def test_flatpack_load_is_bitwise_equal_to_orbax(tmp_path):
+    """The fast boot format and the canonical orbax checkpoint must hold
+    identical tensors; removing the .fpk falls back to orbax."""
+    import orbax.checkpoint as ocp
+
+    registry.save_init_params("llama-tiny", tmp_path / "p", dtype="float32")
+    fpk = registry.load_params("llama-tiny", tmp_path / "p")
+    via_orbax = ocp.StandardCheckpointer().restore(
+        (tmp_path / "p" / "orbax").resolve())
+    flat_a = jax.tree_util.tree_leaves_with_path(fpk)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(via_orbax))
+    assert len(flat_a) == len(flat_b) > 0
+    for path, leaf in flat_a:
+        ref = flat_b[path]
+        assert np.asarray(leaf).dtype == np.asarray(ref).dtype
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(ref))
+    (tmp_path / "p" / "params.fpk").unlink()
+    fallback = registry.load_params("llama-tiny", tmp_path / "p")
+    assert len(jax.tree_util.tree_leaves(fallback)) == len(flat_a)
+
+
+def test_flatpack_roundtrip_dtypes(tmp_path):
+    """bf16 / int8 / f32 / scalar leaves survive the flat file bitwise."""
+    import ml_dtypes
+
+    from lambdipy_tpu.bundle import flatpack
+
+    tree = {
+        "a": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+              "s": np.float32(3.5)},
+        "q": {"kernel_int8": np.arange(-8, 8, dtype=np.int8).reshape(4, 4),
+              "scale": np.ones((1, 4), np.float32)},
+        "bf": np.arange(6, dtype=np.float32).astype(ml_dtypes.bfloat16),
+    }
+    stats = flatpack.save(tmp_path / "t.fpk", tree)
+    assert stats["n_tensors"] == 5
+    out = flatpack.load(tmp_path / "t.fpk")
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        got = out
+        for k in path:
+            got = got[k.key]
+        assert np.asarray(got).dtype == np.asarray(leaf).dtype
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(leaf))
 
 
 def test_save_and_load_params_sklearn(tmp_path):
