@@ -507,7 +507,11 @@ def doctor_cmd(registry_dir, state_path, probe_timeout):
             [sys.executable, "-c",
              # the one place LAMBDIPY_PLATFORM is honored is the shared
              # helper — the probe must diagnose the same environment the
-             # real entry points run in
+             # real entry points run in. LAMBDIPY_DOCTOR_WEDGE is fault
+             # injection (the bench.py pattern): tests prove the
+             # timeout->diagnosis path without betting on a slow tunnel
+             "import os, time\n"
+             "if os.environ.get('LAMBDIPY_DOCTOR_WEDGE'): time.sleep(3600)\n"
              "from lambdipy_tpu.utils.platform import apply_platform_override\n"
              "apply_platform_override()\n"
              "import jax\n"

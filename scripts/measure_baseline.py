@@ -40,6 +40,13 @@ CONFIGS = {
         "request": {"random": True}},
     4: {"recipe": "jax-bert", "platform": "device",
         "request": {"input_ids": [[101, 2054, 2003, 102]]}},
+    # config 5 exemplar: the 8B recipe needs a v5e-4; this is the same
+    # int8 + compile-once-decode serve path at single-chip scale. The
+    # multi-chip sharding evidence for the full recipe is the CPU-mesh
+    # dryrun (__graft_entry__.dryrun_multichip).
+    5: {"recipe": "jax-llama-micro", "platform": "device",
+        "request": {"tokens": [[1, 2, 3, 4, 5, 6, 7, 8]],
+                    "max_new_tokens": 32}},
 }
 
 
@@ -168,6 +175,13 @@ def measure_config(num: int, *, invokes: int = 30,
                 record["d2h_rtt_ms"] = round(d2h_floor, 3)
                 record["serve_overhead_p50_ms"] = round(
                     max(0.0, record["invoke_p50_ms"] - d2h_floor), 3)
+        n_new = cfg["request"].get("max_new_tokens")
+        if n_new:
+            # decode throughput, net of the transport floor when known
+            net_ms = record.get("serve_overhead_p50_ms",
+                                record["invoke_p50_ms"])
+            if net_ms > 0:
+                record["decode_tok_s"] = round(n_new / (net_ms / 1e3), 1)
     finally:
         rt.stop(name)
     return record
@@ -193,7 +207,7 @@ def main() -> int:
     else:
         nums = [1, 2]
         if tpu_reachable():
-            nums += [3, 4]
+            nums += [3, 4, 5]
         else:
             print("device unreachable; measuring CPU configs only",
                   file=sys.stderr)

@@ -126,6 +126,9 @@ def test_doctor_diagnoses_wedged_device(tmp_path, monkeypatch):
     an indefinite hang (the axon transport has done exactly this)."""
     monkeypatch.delenv("LAMBDIPY_PLATFORM", raising=False)
     monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    # deterministic wedge: the probe child hangs before touching jax, so
+    # the test doesn't depend on the real transport being slow
+    monkeypatch.setenv("LAMBDIPY_DOCTOR_WEDGE", "1")
     r = CliRunner().invoke(main, [
         "doctor", "--probe-timeout", "1",
         "--registry", str(tmp_path / "reg"),

@@ -178,6 +178,32 @@ def test_flatpack_roundtrip_dtypes(tmp_path):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(leaf))
 
 
+def test_serving_cast_applies_when_inert(tmp_path):
+    """bf16-serving models whose modules cast params at compute (ResNet,
+    BERT) get their f32 kernels stored as bf16 — with a bitwise forward
+    parity gate, so the cast can never change served outputs."""
+    info = registry.save_init_params("bert-tiny", tmp_path / "p",
+                                     dtype="bfloat16")
+    assert info["serving_cast"]["applied"], info
+    assert info["serving_cast"]["bytes_saved"] > 0
+    params = registry.load_params("bert-tiny", tmp_path / "p")
+    adapter = registry.get("bert-tiny").build(dtype="bfloat16")
+    out = adapter.forward(params, *adapter.example_batch(1))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_serving_cast_rejected_when_numerics_change(tmp_path):
+    """A bf16-serving Llama computes its lm_head in f32: casting that
+    kernel would change logits, so the parity gate must reject the cast
+    and keep f32 weights wholesale."""
+    info = registry.save_init_params("llama-tiny", tmp_path / "p",
+                                     dtype="bfloat16")
+    assert not info["serving_cast"]["applied"], info
+    params = registry.load_params("llama-tiny", tmp_path / "p")
+    leaves = jax.tree_util.tree_leaves(params)
+    assert any(x.dtype == np.float32 and x.ndim >= 2 for x in leaves)
+
+
 def test_save_and_load_params_sklearn(tmp_path):
     info = registry.save_init_params("tabular", tmp_path / "p")
     assert info["format"] == "joblib"

@@ -57,3 +57,27 @@ def test_bert_serve_path_on_device(device_ok, tmp_path):
     p50_net = rec.get("serve_overhead_p50_ms", rec["invoke_p50_ms"])
     assert p50_net < 100.0, rec  # sanity bound, not the star
     publish({"config4": rec})
+
+
+def test_llama_int8_generate_serve_path(device_ok, tmp_path):
+    """Config 5's serve path (int8 weights + compile-once decode) on the
+    chip, at the single-chip exemplar scale; the full 8B recipe's v5e-4
+    sharding is proven by the CPU-mesh dryrun, whose evidence rides in
+    the published record."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    from measure_baseline import measure_config, publish
+
+    rec = measure_config(5, invokes=20, work=tmp_path)
+    assert rec["platform"] not in ("cpu",), rec
+    assert rec.get("decode_tok_s", 0) > 50, rec  # sanity: real decode speed
+    dry = subprocess.run(
+        [_sys.executable, str(_Path(__file__).parents[1] / "__graft_entry__.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "GRAFT_DRYRUN_DEVICES": "8"})
+    assert dry.returncode == 0, (dry.stdout + dry.stderr)[-500:]
+    lines = dry.stdout.strip().splitlines()
+    rec["multichip_dryrun"] = "pass: " + (lines[-1] if lines else "(no output)")
+    publish({"config5": rec})
