@@ -293,11 +293,17 @@ class LlamaModel(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, mask=None, cache=None):
+    def __call__(self, tokens, positions=None, mask=None, cache=None,
+                 logit_positions=None):
         """Returns (logits, new_cache).
 
         prefill: cache=None, tokens [b, s] -> cache entries sized s.
         decode:  cache=list of {k,v,index} (static max_len), tokens [b, 1].
+        logit_positions: optional [b] int32 — compute lm_head only at that
+        position per row (logits [b, 1, v]). Serving prefill needs one
+        row of logits, not s: the full [b, s, vocab] f32 tensor is the
+        largest activation of the whole serve path (8B at 8k context:
+        4 GB) and s unneeded lm_head matmuls.
         """
         cfg = self.cfg
         b, s = tokens.shape
@@ -314,6 +320,10 @@ class LlamaModel(nn.Module):
             x, c = LlamaBlock(cfg, name=f"layer_{i}")(x, positions, mask, layer_cache)
             new_cache.append(c)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if logit_positions is not None:
+            x = jnp.take_along_axis(
+                x, jnp.broadcast_to(logit_positions[:, None, None],
+                                    (b, 1, x.shape[-1])), axis=1)
         logits = QDense(cfg.vocab_size, cfg.quant, jnp.float32, cfg.matmul_backend, name="lm_head")(x)
         return logits, new_cache
 
@@ -538,22 +548,33 @@ def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
     cfg = model.cfg
     b, sb = prompt.shape
     length = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (b,))
-    logits, prefill_cache = model.apply(params, prompt)
+    # lm_head only at each row's last real position: [b, 1, v], never the
+    # [b, sb, v] full-prefill logits tensor
+    logits, prefill_cache = model.apply(params, prompt,
+                                        logit_positions=length - 1)
     cache = prefill_into_cache(cfg, prefill_cache, b, cache_len, 0)
     for entry in cache:
         entry["index"] = length
-    v = logits.shape[-1]
-    last = jnp.take_along_axis(
-        logits, jnp.broadcast_to((length - 1)[:, None, None], (b, 1, v)),
-        axis=1)[:, 0, :]
+    last = logits[:, 0, :]
 
     def select(lg, rng):
         lg = lg.astype(jnp.float32)
-        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        t = jnp.maximum(temperature, jnp.float32(1e-6))
-        filt = filter_logits_runtime(lg / t, top_k, top_p)
-        sampled = jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
-        return jnp.where(temperature > jnp.float32(0.0), sampled, greedy)
+
+        def sampled(args):
+            lg, rng = args
+            t = jnp.maximum(temperature, jnp.float32(1e-6))
+            filt = filter_logits_runtime(lg / t, top_k, top_p)
+            return jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
+
+        def greedy(args):
+            lg, _ = args
+            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+        # cond, not where: greedy requests (temperature <= 0) must not pay
+        # the sampling path's two vocab-sized sorts per emitted token —
+        # they dominate small-model decode steps
+        return jax.lax.cond(temperature > jnp.float32(0.0), sampled, greedy,
+                            (lg, rng))
 
     rng, sub = jax.random.split(rng)
     first = select(last.astype(jnp.float32), sub)
@@ -694,7 +715,9 @@ def _decode(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
     b, s = prompt_tokens.shape
     max_len = max_len or min(cfg.max_len, s + max_new_tokens)
 
-    logits, prefill_cache = model.apply(params, prompt_tokens)
+    logits, prefill_cache = model.apply(
+        params, prompt_tokens,
+        logit_positions=jnp.full((b,), s - 1, jnp.int32))
     cache = prefill_into_cache(cfg, prefill_cache, b, max_len, s)
     rng, sub = jax.random.split(rng)
     first_token = select_fn(logits[:, -1, :].astype(jnp.float32), sub)

@@ -309,7 +309,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         # prompt stays a host numpy array until the chosen path needs it:
         # the server/batcher convert internally, only the legacy
         # adapter.generate path pays a device transfer here
-        if batcher is not None and prompt.shape[0] == 1:
+        if batcher is not None and len(prompt) == 1:
             return batcher.generate(prompt[0], max_new_tokens=max_new,
                                     **sample_kwargs)
         if server is not None:
@@ -350,10 +350,29 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             prompt = np.asarray([ids], np.int32)
             from_text = True
         else:
-            raw = np.asarray(req["tokens"], dtype=np.int32)
-            if raw.size == 0:
-                return {"ok": False, "error": "empty prompt"}
-            prompt = raw[None, :] if raw.ndim == 1 else raw
+            raw = req["tokens"]
+            if isinstance(raw, (list, tuple)) and raw and \
+                    isinstance(raw[0], (list, tuple, np.ndarray)):
+                # list-of-rows: may be RAGGED (different prompt lengths);
+                # np.asarray would crash on inhomogeneous shape, and the
+                # compile-once server decodes ragged batches natively
+                rows = [np.asarray(r, dtype=np.int32).reshape(-1)
+                        for r in raw]
+                if any(r.size == 0 for r in rows):
+                    return {"ok": False, "error": "empty prompt row"}
+                if len({len(r) for r in rows}) == 1:
+                    prompt = np.stack(rows)
+                elif server is not None:
+                    prompt = rows
+                else:
+                    return {"ok": False, "error":
+                            "ragged prompt rows need the compile-once "
+                            "server (model exposes no make_server)"}
+            else:
+                arr = np.asarray(raw, dtype=np.int32)
+                if arr.size == 0:
+                    return {"ok": False, "error": "empty prompt"}
+                prompt = arr[None, :] if arr.ndim == 1 else arr
         # tolerate JSON null (= "use the default"); explicit 0 is honored
         raw_new = req.get("max_new_tokens")
         max_new = default_new if raw_new is None else int(raw_new)

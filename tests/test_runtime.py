@@ -281,6 +281,31 @@ def test_generate_handler_null_knobs(llama_bundle):
     assert out["ok"] and out["n_new"] == 4  # bundle default_new
 
 
+def test_generate_handler_ragged_json_rows(llama_bundle):
+    """A JSON list of different-length prompt rows decodes as one ragged
+    batch (each row from its own prompt end) and matches solo serving;
+    equal-length rows still take the rectangular path."""
+    import numpy as np
+
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    report = load_bundle(llama_bundle)
+    ragged = report.handler.invoke(report.state, {
+        "tokens": [[1, 2, 3], [4, 5, 6, 7, 8]], "max_new_tokens": 4})
+    assert ragged["ok"] and len(ragged["tokens"]) == 2, ragged
+    for row in ragged["tokens"]:
+        assert len(row) == 4
+    solo = report.handler.invoke(report.state, {
+        "tokens": [4, 5, 6, 7, 8], "max_new_tokens": 4})
+    assert ragged["tokens"][1] == solo["tokens"][0]
+    rect = report.handler.invoke(report.state, {
+        "tokens": [[1, 2, 3], [4, 5, 6]], "max_new_tokens": 4})
+    assert rect["ok"] and np.asarray(rect["tokens"]).shape == (2, 4)
+    empty = report.handler.invoke(report.state,
+                                  {"tokens": [[1, 2], []]})
+    assert not empty["ok"] and "empty" in empty["error"]
+
+
 def test_generate_handler_serves_compile_once(llama_bundle):
     """The handler routes through LlamaServer: varied lengths and knobs in
     one bucket reuse a single compiled program."""
