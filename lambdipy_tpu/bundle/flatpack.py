@@ -111,6 +111,32 @@ def save(path: Path, tree) -> dict:
     return {"n_tensors": len(entries), "bytes": offset}
 
 
+def save_checkpoint_files(params_dir: Path, params,
+                          params_format: str = "both") -> str:
+    """Shared bundle-params writer (registry.save_init_params and
+    convert.save_hf_params): write the canonical orbax checkpoint and/or
+    the flat boot file per ``params_format`` and return the format string
+    recorded in the manifest. Rejects unknown formats up front — silently
+    writing nothing would surface only at serve boot."""
+    if params_format not in ("both", "fpk", "orbax"):
+        raise ValueError(f"params_format must be 'both', 'fpk' or 'orbax', "
+                         f"got {params_format!r}")
+    params_dir = Path(params_dir)
+    params_dir.mkdir(parents=True, exist_ok=True)
+    fmt = []
+    if params_format in ("both", "orbax"):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save((params_dir / "orbax").resolve(), params)
+        ckptr.wait_until_finished()
+        fmt.append("orbax")
+    if params_format in ("both", "fpk"):
+        save(params_dir / "params.fpk", params)
+        fmt.append("fpk")
+    return "+".join(fmt)
+
+
 def load(path: Path):
     """Memory-map ``path`` and return the nested-dict tree of numpy views."""
     path = Path(path)

@@ -89,7 +89,8 @@ def materialize_payload(recipe: Recipe, bundle_dir: Path) -> dict:
         params_dir = Path(bundle_dir) / "params"
         info = model_registry.save_init_params(
             payload.model, params_dir, dtype=payload.dtype, quant=payload.quant,
-            extra=dict(payload.extra))
+            extra=dict(payload.extra),
+            params_format=payload.params_format)
         manifest_payload["params"] = "params"
         manifest_payload["params_info"] = info
     elif payload.params == "hf":
@@ -102,7 +103,8 @@ def materialize_payload(recipe: Recipe, bundle_dir: Path) -> dict:
             raise ValueError(
                 f"recipe {recipe.name}: params='hf' needs [payload.extra] hf_path")
         info = save_hf_params(hf_path, Path(bundle_dir) / "params",
-                              quant=payload.quant)
+                              quant=payload.quant,
+                              params_format=payload.params_format)
         manifest_payload["params"] = "params"
         manifest_payload["params_info"] = info
     return manifest_payload

@@ -266,14 +266,14 @@ def import_hf_bert(source, *, config_overrides: dict | None = None):
 
 
 def save_hf_params(hf_path: str | Path, params_dir: Path, *,
-                   quant: str | None = None) -> dict:
+                   quant: str | None = None,
+                   params_format: str = "both") -> dict:
     """Bundle-build hook: convert a local HF Llama checkpoint and persist
     it as the bundle's orbax params (bundle/package.py params="hf")."""
     from lambdipy_tpu.utils.platform import prefer_cpu_backend
 
     prefer_cpu_backend()  # host-side conversion; leave the TPU to the warmer
     import jax
-    import orbax.checkpoint as ocp
 
     from lambdipy_tpu.models.llama import quantize_params
 
@@ -282,14 +282,11 @@ def save_hf_params(hf_path: str | Path, params_dir: Path, *,
         params = jax.device_get(quantize_params(params))
     params_dir = Path(params_dir)
     params_dir.mkdir(parents=True, exist_ok=True)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save((params_dir / "orbax").resolve(), params)
-    ckptr.wait_until_finished()
-    from lambdipy_tpu.bundle import flatpack
+    from lambdipy_tpu.bundle.flatpack import save_checkpoint_files
 
-    flatpack.save(params_dir / "params.fpk", params)
+    fmt = save_checkpoint_files(params_dir, params, params_format)
     n = sum(v.size for v in jax_tree_leaves(params))
-    info = {"format": "orbax+fpk", "n_params": int(n), "source": "hf",
+    info = {"format": fmt, "n_params": int(n), "source": "hf",
             "hf_path": str(hf_path), "quant": quant,
             # the COMPLETE architecture: the serve side rebuilds the module
             # from exactly this dict, so every field that changes numerics

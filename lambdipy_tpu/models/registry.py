@@ -511,9 +511,14 @@ def shrink_params_for_serving(adapter, params, dtype_name: str):
 
 def save_init_params(model: str, params_dir: Path, *, dtype: str = "bfloat16",
                      quant: str | None = None, extra: dict | None = None,
-                     seed: int = 0) -> dict:
+                     seed: int = 0, params_format: str = "both") -> dict:
     """Initialize a model's params and persist them into a bundle params dir.
-    Returns an info dict recorded in the bundle manifest."""
+    Returns an info dict recorded in the bundle manifest.
+
+    params_format (jax families): "both" writes the canonical orbax
+    checkpoint plus the params.fpk boot accelerator; "fpk"/"orbax" write
+    one — big payloads (8 GB for int8 Llama-8B) must not ship their
+    dominant bytes twice."""
     spec = get(model)
     params_dir = Path(params_dir)
     params_dir.mkdir(parents=True, exist_ok=True)
@@ -524,7 +529,6 @@ def save_init_params(model: str, params_dir: Path, *, dtype: str = "bfloat16",
         # starves the builder's warm subprocess (the step that must own it)
         prefer_cpu_backend()
         import jax
-        import orbax.checkpoint as ocp
 
         adapter = spec.build(dtype=dtype, quant=quant, extra=extra)
         params = adapter.init_params(seed=seed)
@@ -534,17 +538,14 @@ def save_init_params(model: str, params_dir: Path, *, dtype: str = "bfloat16",
         # save-time device/shardings otherwise, and a bundle built on TPU
         # must still boot on CPU (and vice versa) — serve re-shards on load
         params = jax.device_get(params)
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save((params_dir / "orbax").resolve(), params)
-        ckptr.wait_until_finished()
-        # flat single-file mirror of the same tree: the boot path prefers
-        # it (~0.1 s mmap read vs ~3.6 s orbax restore on this 1-core
-        # host — a third of the cold-start budget; bundle/flatpack.py)
-        from lambdipy_tpu.bundle import flatpack
+        # orbax stays canonical; params.fpk is the boot accelerator the
+        # loader prefers (~0.1 s mmap read vs ~3.6 s orbax restore on this
+        # 1-core host — a third of the cold-start budget)
+        from lambdipy_tpu.bundle.flatpack import save_checkpoint_files
 
-        flatpack.save(params_dir / "params.fpk", params)
-        info = {"format": "orbax+fpk", "n_params": int(n_params), "seed": seed,
-                "serving_cast": shrink}
+        fmt = save_checkpoint_files(params_dir, params, params_format)
+        info = {"format": fmt, "n_params": int(n_params),
+                "seed": seed, "serving_cast": shrink}
     elif spec.kind == "sklearn":
         import joblib
 

@@ -67,6 +67,11 @@ class PayloadSpec:
     mesh: tuple[tuple[str, int], ...] = ()  # e.g. (("dp",1),("tp",4))
     quant: str | None = None  # e.g. "int8" for Llama config 5
     extra: tuple[tuple[str, str], ...] = ()
+    # which checkpoint formats the bundle ships: "both" (orbax canonical +
+    # params.fpk boot accelerator), "fpk" (flat file only — big payloads
+    # must not double their dominant bytes; an 8B int8 bundle is 8 GB per
+    # copy), or "orbax"
+    params_format: str = "both"
 
     def mesh_dict(self) -> dict[str, int]:
         return dict(self.mesh)
@@ -180,6 +185,10 @@ def load_recipe_dict(doc: dict, *, origin: str = "<dict>") -> Recipe:
         mesh_doc = ydoc.get("mesh", {})
         _expect(isinstance(mesh_doc, dict) and all(isinstance(v, int) and v >= 1 for v in mesh_doc.values()),
                 f"{origin}: recipe {name}: payload.mesh must map axis name -> positive int")
+        params_format = str(ydoc.get("params_format", "both"))
+        _expect(params_format in ("both", "fpk", "orbax"),
+                f"{origin}: recipe {name}: payload.params_format must be "
+                f"'both', 'fpk' or 'orbax', got {params_format!r}")
         payload = PayloadSpec(
             model=model,
             handler=handler,
@@ -189,6 +198,7 @@ def load_recipe_dict(doc: dict, *, origin: str = "<dict>") -> Recipe:
             mesh=tuple(mesh_doc.items()),
             quant=ydoc.get("quant"),
             extra=tuple(sorted((str(k), str(v)) for k, v in ydoc.get("extra", {}).items())),
+            params_format=params_format,
         )
 
     return Recipe(

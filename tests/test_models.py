@@ -178,6 +178,20 @@ def test_flatpack_roundtrip_dtypes(tmp_path):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(leaf))
 
 
+def test_params_format_fpk_only(tmp_path):
+    """params_format='fpk' writes only the flat file (big payloads must
+    not ship their dominant bytes twice) and load_params still serves."""
+    info = registry.save_init_params("llama-tiny", tmp_path / "p",
+                                     dtype="float32", params_format="fpk")
+    assert info["format"] == "fpk"
+    assert (tmp_path / "p" / "params.fpk").is_file()
+    assert not (tmp_path / "p" / "orbax").exists()
+    params = registry.load_params("llama-tiny", tmp_path / "p")
+    adapter = registry.get("llama-tiny").build()
+    logits = adapter.forward(params, jnp.asarray([[1, 2]], jnp.int32))
+    assert logits.shape[-1] == adapter.config.vocab_size
+
+
 def test_serving_cast_applies_when_inert(tmp_path):
     """bf16-serving models whose modules cast params at compute (ResNet,
     BERT) get their f32 kernels stored as bf16 — with a bitwise forward
