@@ -448,7 +448,10 @@ def serve_cmd(bundle, port, registry_dir):
 @main.command("invoke")
 @click.argument("name")
 @click.option("--data", default="{}", help="JSON request body")
-def invoke_cmd(name, data):
+@click.option("--stream", is_flag=True,
+              help="stream the response (generate handlers): one JSON "
+                   "line per decode segment as tokens are emitted")
+def invoke_cmd(name, data, stream):
     """Invoke a deployed function."""
     from lambdipy_tpu.runtime.deploy import DeployError, LocalRuntime
 
@@ -457,7 +460,11 @@ def invoke_cmd(name, data):
     except json.JSONDecodeError as e:
         raise click.ClickException(f"--data is not valid JSON: {e}") from e
     try:
-        click.echo(json.dumps(LocalRuntime().invoke(name, request)))
+        if stream:
+            for chunk in LocalRuntime().invoke_stream(name, request):
+                click.echo(json.dumps(chunk))
+        else:
+            click.echo(json.dumps(LocalRuntime().invoke(name, request)))
     except DeployError as e:
         raise click.ClickException(str(e)) from e
 

@@ -498,12 +498,16 @@ def filter_logits_runtime(logits, top_k, top_p):
 
 
 def _scan_decode(model: LlamaModel, params, select_fn, first, cache, start,
-                 done0, rng, eos_id, decode_steps: int):
-    """The decode scan shared by the exact-shape path (:func:`_decode`) and
-    the bucketed serving path (:func:`_serve_decode`): one compiled step
-    per token over a static-shape cache. ``eos_id`` is an int32 operand;
-    < 0 disables eos latching (``done`` then never becomes True, so the
-    filler value is never emitted)."""
+                 done0, rng, eos_id, decode_steps: int,
+                 return_carry: bool = False):
+    """The decode scan shared by the exact-shape path (:func:`_decode`),
+    the bucketed serving path (:func:`_serve_decode`) and the streaming
+    segment path: one compiled step per token over a static-shape cache.
+    ``eos_id`` is an int32 operand; < 0 disables eos latching (``done``
+    then never becomes True, so the filler value is never emitted).
+    ``return_carry`` additionally returns the final (tok, cache, pos,
+    done, rng) carry so a later segment can continue the decode exactly
+    where this one stopped."""
     b = first.shape[0]
     has_eos = eos_id >= 0
 
@@ -521,9 +525,10 @@ def _scan_decode(model: LlamaModel, params, select_fn, first, cache, start,
         done = done | (has_eos & (nxt == eos_id))
         return (nxt, new_cache, pos + 1, done, rng), tok
 
-    _, toks = jax.lax.scan(step, (first, cache, start, done0, rng), None,
-                           length=decode_steps)
-    return jnp.transpose(toks)  # [b, decode_steps]
+    carry, toks = jax.lax.scan(step, (first, cache, start, done0, rng), None,
+                               length=decode_steps)
+    toks = jnp.transpose(toks)  # [b, decode_steps]
+    return (toks, carry) if return_carry else toks
 
 
 def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
@@ -545,17 +550,14 @@ def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
     traced operands: one compiled (sb, decode_steps) program serves every
     sampling configuration and every prompt length in the bucket.
     """
-    cfg = model.cfg
-    b, sb = prompt.shape
-    length = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (b,))
-    # lm_head only at each row's last real position: [b, 1, v], never the
-    # [b, sb, v] full-prefill logits tensor
-    logits, prefill_cache = model.apply(params, prompt,
-                                        logit_positions=length - 1)
-    cache = prefill_into_cache(cfg, prefill_cache, b, cache_len, 0)
-    for entry in cache:
-        entry["index"] = length
-    last = logits[:, 0, :]
+    select = _serve_select(temperature, top_k, top_p)
+    carry = _serve_prefill(model, params, prompt, length, select, rng,
+                           eos_id, cache_len=cache_len)
+    return _scan_decode(model, params, select, *carry, eos_id, decode_steps)
+
+
+def _serve_select(temperature, top_k, top_p):
+    """Token-selection closure over runtime knob operands."""
 
     def select(lg, rng):
         lg = lg.astype(jnp.float32)
@@ -576,11 +578,30 @@ def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
         return jax.lax.cond(temperature > jnp.float32(0.0), sampled, greedy,
                             (lg, rng))
 
+    return select
+
+
+def _serve_prefill(model: LlamaModel, params, prompt, length, select, rng,
+                   eos_id, *, cache_len: int):
+    """Bucketed serving prefill: embed the prompt into a ``cache_len``
+    decode cache and select the first token. Returns the decode carry
+    ``(first, cache, pos, done, rng)`` consumed by :func:`_scan_decode` —
+    either fused into one program (:func:`_serve_decode`) or as its own
+    compiled program for streaming segments."""
+    cfg = model.cfg
+    b, sb = prompt.shape
+    length = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (b,))
+    # lm_head only at each row's last real position: [b, 1, v], never the
+    # [b, sb, v] full-prefill logits tensor
+    logits, prefill_cache = model.apply(params, prompt,
+                                        logit_positions=length - 1)
+    cache = prefill_into_cache(cfg, prefill_cache, b, cache_len, 0)
+    for entry in cache:
+        entry["index"] = length
     rng, sub = jax.random.split(rng)
-    first = select(last.astype(jnp.float32), sub)
+    first = select(logits[:, 0, :].astype(jnp.float32), sub)
     done0 = (eos_id >= 0) & (first == eos_id)
-    return _scan_decode(model, params, select, first, cache, length, done0,
-                        rng, eos_id, decode_steps)
+    return first, cache, length, done0, rng
 
 
 def _next_bucket(n: int, lo: int) -> int:
@@ -615,14 +636,19 @@ class LlamaServer:
         self._fns: dict[tuple[int, int, int], Any] = {}
 
     @property
-    def buckets(self) -> list[tuple[int, int, int]]:
-        """Snapshot of the (batch, prompt, decode) bucket keys compiled so
-        far (safe against concurrent inserts from another serving thread)."""
-        return sorted(self._fns)
+    def buckets(self) -> list[tuple]:
+        """Snapshot of the bucket keys compiled so far — (batch, prompt,
+        decode) for fused programs, ("stream", batch, prompt, cache_len,
+        segment) for streaming pairs (safe against concurrent inserts
+        from another serving thread; repr-keyed sort tolerates the mixed
+        tuple shapes)."""
+        return sorted(self._fns, key=repr)
 
     @property
     def compile_count(self) -> int:
-        return sum(fn._cache_size() for fn in list(self._fns.values()))
+        return sum(f._cache_size()
+                   for fn in list(self._fns.values())
+                   for f in (fn if isinstance(fn, tuple) else (fn,)))
 
     def _compiled(self, b: int, sb: int, steps: int):
         key = (b, sb, steps)
@@ -639,6 +665,51 @@ class LlamaServer:
             self._fns[key] = jax.jit(fn)
         return self._fns[key]
 
+    def _validate(self, s: int, max_new_tokens: int) -> None:
+        cfg = self.model.cfg
+        if max_new_tokens < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+        if max_new_tokens > self.decode_cap:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds the server's "
+                f"decode cap {self.decode_cap}")
+        if s + max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_len {cfg.max_len}")
+
+    @staticmethod
+    def _pad_rows(rows, lengths, bb: int, sb: int):
+        """(padded [bb, sb] int32 array, per-row length operand) — dummy
+        length-1 rows fill the batch bucket; they are free under per-row
+        lengths."""
+        import numpy as np
+
+        padded = np.zeros((bb, sb), np.int32)
+        for r, row in enumerate(rows):
+            padded[r, :lengths[r]] = row
+        return (jnp.asarray(padded),
+                jnp.asarray(lengths + [1] * (bb - len(rows)), jnp.int32))
+
+    @staticmethod
+    def _knob_operands(temperature, top_k, top_p, seed, eos_id):
+        """Runtime sampling-knob operands shared by the fused and
+        streaming programs (None = the knob's disabled sentinel)."""
+        return (jnp.float32(temperature if temperature is not None else 0.0),
+                jnp.int32(top_k if top_k is not None else 0),
+                jnp.float32(top_p if top_p is not None else 1.0),
+                jax.random.PRNGKey(seed),
+                jnp.int32(eos_id if eos_id is not None else -1))
+
+    def _mesh_ctx(self):
+        if self.mesh is None:
+            from contextlib import nullcontext
+
+            return nullcontext()
+        from lambdipy_tpu.parallel.mesh import use_mesh
+
+        return use_mesh(self.mesh)
+
     def generate(self, prompt_tokens, *, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, seed: int = 0,
@@ -651,16 +722,7 @@ class LlamaServer:
         cfg = self.model.cfg
         rows, lengths = self._normalize_prompts(prompt_tokens)
         b, s = len(rows), max(lengths)
-        if max_new_tokens < 0:
-            raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
-        if max_new_tokens > self.decode_cap:
-            raise ValueError(
-                f"max_new_tokens {max_new_tokens} exceeds the server's "
-                f"decode cap {self.decode_cap}")
-        if s + max_new_tokens > cfg.max_len:
-            raise ValueError(
-                f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
-                f"max_len {cfg.max_len}")
+        self._validate(s, max_new_tokens)
         # prefer power-of-two buckets for reuse, but shrink toward the
         # exact request near the max_len boundary instead of rejecting:
         # any request with s + max_new <= max_len must be servable
@@ -668,28 +730,98 @@ class LlamaServer:
                     self.decode_cap, cfg.max_len - s)
         sb = min(_next_bucket(s, self.min_bucket), cfg.max_len - steps)
         # batch is bucketed too (micro-batching produces nondeterministic
-        # sizes; each distinct b would otherwise compile at request time);
-        # dummy length-1 rows are free under per-row lengths
+        # sizes; each distinct b would otherwise compile at request time)
         bb = _next_bucket(b, 1)
-        padded = np.zeros((bb, sb), np.int32)
-        for r, row in enumerate(rows):
-            padded[r, :lengths[r]] = row
         fn = self._compiled(bb, sb, steps)
-        args = (self.params, jnp.asarray(padded),
-                jnp.asarray(lengths + [1] * (bb - b), jnp.int32),
-                jnp.float32(temperature if temperature is not None else 0.0),
-                jnp.int32(top_k if top_k is not None else 0),
-                jnp.float32(top_p if top_p is not None else 1.0),
-                jax.random.PRNGKey(seed),
-                jnp.int32(eos_id if eos_id is not None else -1))
-        if self.mesh is not None:
-            from lambdipy_tpu.parallel.mesh import use_mesh
-
-            with use_mesh(self.mesh):
-                out = fn(*args)
-        else:
+        prompt_op, length_op = self._pad_rows(rows, lengths, bb, sb)
+        args = (self.params, prompt_op, length_op,
+                *self._knob_operands(temperature, top_k, top_p, seed, eos_id))
+        with self._mesh_ctx():
             out = fn(*args)
         return np.asarray(jax.device_get(out))[:b, :max_new_tokens]
+
+    def _stream_fns(self, b: int, sb: int, cache_len: int, segment: int):
+        """Compiled (prefill, segment) pair for streaming. The prefill
+        program returns the decode carry; each segment program advances it
+        ``segment`` tokens and returns (tokens, carry). Cached like the
+        fused programs, so streaming adds at most two programs per
+        bucket."""
+        key = ("stream", b, sb, cache_len, segment)
+        if key not in self._fns:
+            def prefill(params, prompt, length, temperature, top_k, top_p,
+                        rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                return _serve_prefill(self.model, params, prompt, length,
+                                      select, rng, eos_id,
+                                      cache_len=cache_len)
+
+            def seg(params, temperature, top_k, top_p, first, cache, pos,
+                    done, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                return _scan_decode(self.model, params, select, first, cache,
+                                    pos, done, rng, eos_id, segment,
+                                    return_carry=True)
+
+            self._fns[key] = (jax.jit(prefill), jax.jit(seg))
+        return self._fns[key]
+
+    def generate_stream(self, prompt_tokens, *, max_new_tokens: int,
+                        temperature: float = 0.0, top_k: int | None = None,
+                        top_p: float | None = None, seed: int = 0,
+                        eos_id: int | None = None, segment: int = 16):
+        """Streaming :meth:`generate`: yields ``[b, k]`` numpy chunks
+        (k <= segment) as they decode, stopping early once every row has
+        latched eos. Concatenated chunks are EXACTLY the fused
+        ``generate`` output prefix — the segment boundaries don't change
+        the RNG walk, so a seeded sampled stream matches its non-streamed
+        twin token for token. Time-to-first-token is one prefill plus one
+        segment instead of the whole decode."""
+        import numpy as np
+
+        cfg = self.model.cfg
+        rows, lengths = self._normalize_prompts(prompt_tokens)
+        b, s = len(rows), max(lengths)
+        self._validate(s, max_new_tokens)
+        segment = max(1, min(int(segment), max(1, max_new_tokens)))
+        if max_new_tokens == 0:
+            return
+        # same bucketing discipline as generate(): pow-2 prompt bucket
+        # (shrinking toward the exact prompt near max_len), batch
+        # bucketed, and the SEGMENT COUNT pow-2 bucketed too — cache_len
+        # is part of the compiled-program key, so without it every
+        # distinct ceil(max_new/segment) would compile a fresh pair.
+        # Only ceil(max_new/segment) segments ever run; the bucketed
+        # extras just size the cache. The last segment may run past
+        # max_new_tokens; those tail tokens are discarded, and any of
+        # their cache writes that would land past max_len are
+        # scatter-dropped — every KEPT token attends an in-bounds cache
+        # (length_r + max_new <= max_len is validated above).
+        n_needed = -(-max_new_tokens // segment)
+        n_segs = _next_bucket(n_needed, 1)
+        if s + n_segs * segment > cfg.max_len:
+            n_segs = n_needed  # shrink toward exact near the boundary
+        sb = max(s, min(_next_bucket(s, self.min_bucket),
+                        cfg.max_len - n_segs * segment))
+        bb = _next_bucket(b, 1)
+        cache_len = min(sb + n_segs * segment, cfg.max_len)
+        prefill, seg = self._stream_fns(bb, sb, cache_len, segment)
+        prompt_op, length_op = self._pad_rows(rows, lengths, bb, sb)
+        *knobs, key, eos = self._knob_operands(temperature, top_k, top_p,
+                                               seed, eos_id)
+        with self._mesh_ctx():
+            carry = prefill(self.params, prompt_op, length_op,
+                            *knobs, key, eos)
+            emitted = 0
+            while emitted < max_new_tokens:
+                toks, carry = seg(self.params, *knobs, *carry, eos)
+                chunk = np.asarray(jax.device_get(toks))[:b]
+                take = min(chunk.shape[1], max_new_tokens - emitted)
+                emitted += take
+                yield chunk[:, :take]
+                # all real rows latched eos -> nothing more can be emitted
+                done = np.asarray(jax.device_get(carry[3]))[:b]
+                if eos_id is not None and bool(done.all()):
+                    return
 
     @staticmethod
     def _normalize_prompts(prompt_tokens):

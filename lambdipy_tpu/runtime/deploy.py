@@ -169,6 +169,20 @@ class LocalRuntime:
         dep = self.get(name)
         return _http_json(f"{dep.url}/invoke", request, timeout=timeout)
 
+    def invoke_stream(self, name: str, request: dict, timeout: float = 60.0):
+        """Streaming invoke: sets ``stream: true`` and yields one dict per
+        ndjson line as the server emits decode segments."""
+        dep = self.get(name)
+        req = urllib.request.Request(
+            f"{dep.url}/invoke",
+            data=json.dumps({**request, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for line in resp:  # urllib de-chunks; one JSON object per line
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
     def health(self, name: str) -> dict:
         return _http_json(f"{self.get(name).url}/healthz")
 

@@ -25,12 +25,20 @@ class HandlerState:
     # optional live-stats provider merged into /metrics (e.g. the decode
     # server's bucket/compile counters); must be cheap and non-blocking
     stats_fn: Callable[[], dict] | None = None
+    # optional streaming invoke: request -> iterator of chunk dicts,
+    # last one carrying {"done": true}. None = handler can't stream.
+    invoke_stream_fn: Callable[[dict], Any] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
         out = self.invoke_fn(dict(request or {}))
         out.setdefault("latency_ms", round((time.monotonic() - t0) * 1e3, 3))
         return out
+
+    def invoke_stream(self, request: dict):
+        if self.invoke_stream_fn is None:
+            raise ValueError("handler does not support streaming")
+        return self.invoke_stream_fn(dict(request or {}))
 
     def stats(self) -> dict:
         if self.stats_fn is None:
@@ -323,7 +331,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         return adapter.generate(params, device_prompt, max_new_tokens=max_new,
                                 **sample_kwargs)
 
-    def invoke(req: dict) -> dict:
+    def _parse(req: dict):
+        """Request -> (prompt, max_new, sample_kwargs, from_text), or an
+        error dict (the shared front half of invoke and invoke_stream)."""
         from_text = False
         if req.get("warmup") or req.get("random"):
             if req.get("warmup") and server is not None and batcher is not None:
@@ -338,6 +348,14 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                     server.generate([[1, 2, 3, 4]] * bb,
                                     max_new_tokens=default_new)
                     bb *= 2
+            if req.get("warmup") and server is not None:
+                # pre-compile the streaming (prefill, segment) pair for
+                # the default segment size too: on remote-compile
+                # transports a first streamed request otherwise pays the
+                # whole compile at time-to-first-token
+                for _ in server.generate_stream([1, 2, 3, 4],
+                                                max_new_tokens=default_new):
+                    pass
             prompt = np.asarray([[1, 2, 3, 4]], np.int32)
         elif req.get("text") is not None:
             if tokenizer is None:
@@ -387,6 +405,13 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         if sample_kwargs["eos_id"] is None and from_text and \
                 tokenizer.eos_token_id is not None:
             sample_kwargs["eos_id"] = int(tokenizer.eos_token_id)
+        return prompt, max_new, sample_kwargs, from_text
+
+    def invoke(req: dict) -> dict:
+        parsed = _parse(req)
+        if isinstance(parsed, dict):
+            return parsed
+        prompt, max_new, sample_kwargs, from_text = parsed
         toks = np.asarray(jax.device_get(run(prompt, max_new, sample_kwargs)))
         out = {"ok": True, "tokens": toks.tolist(), "n_new": int(toks.shape[-1])}
         if from_text:
@@ -397,6 +422,38 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             out["completion"] = tokenizer.decode(row)
         return out
 
+    def invoke_stream(req: dict):
+        """Streaming invoke: yields chunk dicts as the decode emits them
+        (LlamaServer.generate_stream), ending with a summary record.
+        Concatenated chunk tokens equal the non-streamed response."""
+        parsed = _parse(req)
+        if isinstance(parsed, dict):
+            yield parsed
+            return
+        prompt, max_new, sample_kwargs, from_text = parsed
+        # clamp the client's segment size to a pow-2 in [4, 64]: it is
+        # part of the compiled-program key, and an arbitrary per-request
+        # value would grow the program cache (and pay a compile) without
+        # bound on a public endpoint
+        from lambdipy_tpu.models.llama import _next_bucket
+
+        segment = min(64, _next_bucket(max(4, int(req.get("segment") or 16)), 4))
+        all_rows = None
+        for chunk in server.generate_stream(prompt, max_new_tokens=max_new,
+                                            segment=segment, **sample_kwargs):
+            all_rows = (chunk if all_rows is None
+                        else np.concatenate([all_rows, chunk], axis=1))
+            yield {"ok": True, "tokens": chunk.tolist()}
+        n_new = 0 if all_rows is None else int(all_rows.shape[1])
+        out = {"ok": True, "done": True, "n_new": n_new}
+        if from_text and all_rows is not None:
+            row = all_rows[0].tolist()
+            eos = sample_kwargs["eos_id"]
+            if eos is not None and eos in row:
+                row = row[:row.index(eos)]
+            out["completion"] = tokenizer.decode(row)
+        yield out
+
     def stats() -> dict:
         if server is None:
             return {}
@@ -406,12 +463,16 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             out["batching"] = batcher.stats()
         return out
 
-    return HandlerState(invoke_fn=invoke, stats_fn=stats, meta={
-        "model": spec["model"], "quant": spec.get("quant"),
-        "sharded": mesh is not None, "tokenizer": tokenizer is not None,
-        "compile_once": server is not None,
-        **({"tokenizer_error": tok_err} if tok_err else {}),
-    })
+    return HandlerState(
+        invoke_fn=invoke, stats_fn=stats,
+        invoke_stream_fn=invoke_stream if server is not None else None,
+        meta={
+            "model": spec["model"], "quant": spec.get("quant"),
+            "sharded": mesh is not None, "tokenizer": tokenizer is not None,
+            "compile_once": server is not None,
+            "streaming": server is not None,
+            **({"tokenizer_error": tok_err} if tok_err else {}),
+        })
 
 
 def torch_text_classify_handler(spec: dict, ctx) -> HandlerState:

@@ -164,6 +164,13 @@ class BundleServer:
                 # observe 0 (and let the process exit) between handler
                 # completion and the 200 actually reaching the client
                 try:
+                    state = server_self.boot.state
+                    if request.get("stream") and \
+                            getattr(state, "invoke_stream_fn", None) is not None:
+                        # the HandlerState method owns the call convention
+                        # (request copy, support check)
+                        self._send_stream(state.invoke_stream, request, t0)
+                        return
                     try:
                         result = server_self.boot.handler.invoke(
                             server_self.boot.state, request)
@@ -179,6 +186,36 @@ class BundleServer:
                 finally:
                     with server_self._inflight_lock:
                         server_self._inflight -= 1
+
+            def _send_stream(self, stream_fn, request: dict, t0: float):
+                """Chunked ndjson response: one JSON line per decode
+                segment, so clients see tokens at time-to-first-segment
+                instead of end-to-end latency. A mid-stream handler error
+                becomes a final {"ok": false} line (headers are already
+                on the wire — there is no 500 to send)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(payload: dict):
+                    body = json.dumps(payload).encode() + b"\n"
+                    self.wfile.write(f"{len(body):x}\r\n".encode())
+                    self.wfile.write(body)
+                    self.wfile.write(b"\r\n")
+
+                try:
+                    for payload in stream_fn(request):
+                        write_chunk(payload)
+                except Exception as e:
+                    server_self.stats.record_error()
+                    log_event(log, "stream invoke failed", error=str(e),
+                              kind=type(e).__name__)
+                    write_chunk({"ok": False, "error": str(e),
+                                 "kind": type(e).__name__})
+                else:
+                    server_self.stats.record((time.monotonic() - t0) * 1e3)
+                self.wfile.write(b"0\r\n\r\n")
 
         return Handler
 

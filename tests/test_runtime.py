@@ -281,6 +281,39 @@ def test_generate_handler_null_knobs(llama_bundle):
     assert out["ok"] and out["n_new"] == 4  # bundle default_new
 
 
+def test_http_streaming_invoke(llama_bundle):
+    """`stream: true` returns chunked ndjson whose concatenated tokens
+    equal the non-streamed response; non-stream requests still work on
+    the same server."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    server = BundleServer(llama_bundle, warmup=False).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        plain = _post(f"{base}/invoke",
+                      {"tokens": [1, 2, 3], "max_new_tokens": 8})
+        req = urllib.request.Request(
+            f"{base}/invoke",
+            data=_json.dumps({"tokens": [1, 2, 3], "max_new_tokens": 8,
+                              "stream": True, "segment": 3}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers.get("Content-Type") == "application/x-ndjson"
+            lines = [_json.loads(ln) for ln in resp if ln.strip()]
+        assert lines[-1].get("done") and lines[-1]["n_new"] == 8
+        toks = []
+        for ln in lines[:-1]:
+            assert ln["ok"], ln
+            toks.extend(ln["tokens"][0])
+        assert toks == plain["tokens"][0]
+    finally:
+        threading.Thread(target=server.stop, daemon=True).start()
+
+
 def test_generate_handler_ragged_json_rows(llama_bundle):
     """A JSON list of different-length prompt rows decodes as one ragged
     batch (each row from its own prompt end) and matches solo serving;

@@ -123,6 +123,64 @@ def test_filter_logits_runtime_matches_static():
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out))
 
 
+def test_stream_matches_fused_generate(tiny_llama):
+    """Concatenated generate_stream chunks are exactly the fused generate
+    output — greedy and seeded-sampled, rectangular and ragged — and the
+    segment boundaries never change the RNG walk."""
+    import numpy as np
+
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params = tiny_llama
+    server = LlamaServer(adapter.module, params)
+    cases = [
+        dict(prompt=[1, 2, 3, 4, 5], kw={}),
+        dict(prompt=[1, 2, 3, 4, 5], kw=dict(temperature=0.9, top_k=7, seed=3)),
+        dict(prompt=[[1, 2, 3], [4, 5, 6, 7, 8]], kw={}),
+    ]
+    for case in cases:
+        fused = server.generate(case["prompt"], max_new_tokens=11, **case["kw"])
+        chunks = list(server.generate_stream(case["prompt"], max_new_tokens=11,
+                                             segment=4, **case["kw"]))
+        assert all(c.shape[1] <= 4 for c in chunks)
+        np.testing.assert_array_equal(np.concatenate(chunks, axis=1), fused)
+
+
+def test_stream_reuses_compiled_pair(tiny_llama):
+    """A second streamed request with different prompt length, max_new
+    (same bucket) and sampling knobs triggers ZERO new compiles — the
+    compile-once contract extends to the streaming pair."""
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params = tiny_llama
+    server = LlamaServer(adapter.module, params)
+    list(server.generate_stream([1, 2, 3], max_new_tokens=10, segment=4))
+    count = server.compile_count
+    assert count > 0
+    list(server.generate_stream([1, 2, 3, 4, 5], max_new_tokens=12,
+                                segment=4, temperature=0.5, top_k=3, seed=9))
+    assert server.compile_count == count
+
+
+def test_stream_stops_early_on_eos(tiny_llama):
+    """Once every row latches eos the stream ends instead of emitting
+    filler segments; the emitted prefix still matches the fused output."""
+    import numpy as np
+
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params = tiny_llama
+    server = LlamaServer(adapter.module, params)
+    fused = server.generate([1, 2, 3], max_new_tokens=16)
+    eos = int(fused[0, 1])  # force an early eos on the 2nd emitted token
+    chunks = list(server.generate_stream([1, 2, 3], max_new_tokens=16,
+                                         segment=2, eos_id=eos))
+    got = np.concatenate(chunks, axis=1)
+    assert got.shape[1] < 16  # stopped early
+    ref = server.generate([1, 2, 3], max_new_tokens=16, eos_id=eos)
+    np.testing.assert_array_equal(got, ref[:, : got.shape[1]])
+
+
 def test_server_greedy_matches_generate(tiny_llama):
     """Bucketed right-padded serving decode == exact-shape greedy decode."""
     adapter, params = tiny_llama
