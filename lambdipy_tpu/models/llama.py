@@ -818,10 +818,14 @@ class LlamaServer:
                 take = min(chunk.shape[1], max_new_tokens - emitted)
                 emitted += take
                 yield chunk[:, :take]
-                # all real rows latched eos -> nothing more can be emitted
-                done = np.asarray(jax.device_get(carry[3]))[:b]
-                if eos_id is not None and bool(done.all()):
-                    return
+                # all real rows latched eos -> nothing more can be
+                # emitted. Fetch the done flags only when eos is active:
+                # each fetch is a host round trip per segment, pure waste
+                # without an eos to latch.
+                if eos_id is not None:
+                    done = np.asarray(jax.device_get(carry[3]))[:b]
+                    if bool(done.all()):
+                        return
 
     @staticmethod
     def _normalize_prompts(prompt_tokens):

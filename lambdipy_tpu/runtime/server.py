@@ -211,11 +211,22 @@ class BundleServer:
                     server_self.stats.record_error()
                     log_event(log, "stream invoke failed", error=str(e),
                               kind=type(e).__name__)
-                    write_chunk({"ok": False, "error": str(e),
-                                 "kind": type(e).__name__})
-                else:
-                    server_self.stats.record((time.monotonic() - t0) * 1e3)
-                self.wfile.write(b"0\r\n\r\n")
+                    # the failure may BE the socket (client disconnected
+                    # mid-stream): the error chunk and terminator then
+                    # have nowhere to go — swallow, don't dump a second
+                    # traceback into http.server per disconnect
+                    try:
+                        write_chunk({"ok": False, "error": str(e),
+                                     "kind": type(e).__name__})
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        self.close_connection = True
+                    return
+                server_self.stats.record((time.monotonic() - t0) * 1e3)
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    self.close_connection = True
 
         return Handler
 
