@@ -256,7 +256,12 @@ class LlamaBlock(nn.Module):
             if jnp.ndim(idx) == 0:
                 ck = jax.lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
                 cv = jax.lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
-                valid = jnp.arange(ck.shape[1])[None, :] <= idx  # [1, t]
+                # chunk query j attends keys <= idx + j — causal within
+                # the chunk, everything before it. s == 1 is the familiar
+                # decode-step mask; s > 1 is a multi-token continuation
+                # chunk (prefix-cache suffix prefill).
+                valid = (jnp.arange(ck.shape[1])[None, None, :]
+                         <= (idx + jnp.arange(s))[None, :, None])  # [1, s, t]
             else:
                 # ragged batch (rows decode from different prompt lengths):
                 # per-row scatter of this step's single position
@@ -264,10 +269,11 @@ class LlamaBlock(nn.Module):
                 rows = jnp.arange(b)
                 ck = cache["k"].at[rows, idx].set(k[:, 0])
                 cv = cache["v"].at[rows, idx].set(v[:, 0])
-                valid = jnp.arange(ck.shape[1])[None, :] <= idx[:, None]  # [b, t]
+                valid = (jnp.arange(ck.shape[1])[None, None, :]
+                         <= idx[:, None, None])  # [b, 1, t]
             ck = shard_hint(ck, "dp", None, "tp")
             cv = shard_hint(cv, "dp", None, "tp")
-            attn_mask = jnp.broadcast_to(valid[:, None, :], (b, s, ck.shape[1]))
+            attn_mask = jnp.broadcast_to(valid, (b, s, ck.shape[1]))
             out = _attend(q, ck, cv, attn_mask)
             new_cache = {"k": ck, "v": cv}
 
@@ -625,7 +631,8 @@ class LlamaServer:
     """
 
     def __init__(self, model: LlamaModel, params, *, mesh=None,
-                 min_bucket: int = 16, decode_cap: int | None = None):
+                 min_bucket: int = 16, decode_cap: int | None = None,
+                 prefix_cache_max: int = 4):
         self.model = model
         self.params = params
         self.mesh = mesh
@@ -633,7 +640,20 @@ class LlamaServer:
         # default: anything the context window allows is servable (power-
         # of-two bucketing bounds distinct compiles at log2(max_len))
         self.decode_cap = decode_cap or model.cfg.max_len
-        self._fns: dict[tuple[int, int, int], Any] = {}
+        self._fns: dict[tuple, Any] = {}
+        # prefix KV cache (shared system prompts): key -> (cache, length).
+        # The KV cache is FUNCTIONAL (immutable jax arrays), so serving
+        # from a cached prefix never copies or locks it — each request's
+        # programs produce fresh buffers. LRU-bounded: a full-window
+        # cache entry is max_len * kv_heads * head_dim * 2 * layers bytes.
+        import threading
+        from collections import OrderedDict
+
+        self._prefix_cache_max = max(1, prefix_cache_max)
+        self._prefixes: "OrderedDict[str, tuple]" = OrderedDict()
+        # the jax arrays are immutable, but the LRU BOOKKEEPING is not:
+        # serving threads insert/refresh/evict concurrently
+        self._prefix_lock = threading.Lock()
 
     @property
     def buckets(self) -> list[tuple]:
@@ -713,15 +733,25 @@ class LlamaServer:
     def generate(self, prompt_tokens, *, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, seed: int = 0,
-                 eos_id: int | None = None):
+                 eos_id: int | None = None, prefix=None):
         """prompt_tokens: [s], [b, s], or a RAGGED list of rows with
         different lengths (each row decodes from its own prompt end) ->
-        [b, max_new_tokens]."""
+        [b, max_new_tokens].
+
+        ``prefix``: optional shared-prefix tokens (single-row requests): a
+        cached prefill KV for them is reused across requests
+        (:meth:`cache_prefix`), and only ``prompt_tokens`` — the suffix
+        after the prefix — is prefilled per request. Output is exactly
+        ``generate(prefix + prompt)``."""
         import numpy as np
 
         cfg = self.model.cfg
         rows, lengths = self._normalize_prompts(prompt_tokens)
         b, s = len(rows), max(lengths)
+        if prefix is not None:
+            return self._generate_with_prefix(
+                prefix, rows, lengths, max_new_tokens, temperature, top_k,
+                top_p, seed, eos_id)
         self._validate(s, max_new_tokens)
         # prefer power-of-two buckets for reuse, but shrink toward the
         # exact request near the max_len boundary instead of rejecting:
@@ -739,6 +769,124 @@ class LlamaServer:
         with self._mesh_ctx():
             out = fn(*args)
         return np.asarray(jax.device_get(out))[:b, :max_new_tokens]
+
+    # -- prefix caching ------------------------------------------------------
+
+    @staticmethod
+    def _prefix_key(tokens) -> str:
+        import hashlib
+
+        import numpy as np
+
+        arr = np.asarray(tokens, np.int32).reshape(-1)
+        return hashlib.sha1(arr.tobytes()).hexdigest()
+
+    def cache_prefix(self, prefix_tokens) -> str:
+        """Prefill ``prefix_tokens`` once and keep its KV cache for
+        :meth:`generate`'s ``prefix=`` path (idempotent; LRU-bounded).
+        Returns the cache key. The stored cache is sized to the full
+        context window so any suffix + decode the window allows can
+        continue from it."""
+        import numpy as np
+
+        cfg = self.model.cfg
+        rows, lengths = self._normalize_prompts(prefix_tokens)
+        if len(rows) != 1:
+            raise ValueError("prefix caching is single-row")
+        s = lengths[0]
+        if s >= cfg.max_len:
+            raise ValueError(f"prefix {s} fills the whole context window")
+        key = self._prefix_key(rows[0])
+        with self._prefix_lock:
+            if key in self._prefixes:
+                self._prefixes.move_to_end(key)
+                return key
+        sb = min(_next_bucket(s, self.min_bucket), cfg.max_len)
+        cache_len = cfg.max_len
+        fkey = ("prefix", sb, cache_len)
+        if fkey not in self._fns:
+            def pf(params, prompt, length):
+                _, prefill_cache = self.model.apply(
+                    params, prompt,
+                    logit_positions=jnp.zeros((1,), jnp.int32))
+                cache = prefill_into_cache(self.model.cfg, prefill_cache, 1,
+                                           cache_len, 0)
+                for entry in cache:
+                    entry["index"] = length  # int32 scalar
+                return cache
+
+            self._fns[fkey] = jax.jit(pf)
+        prompt_op, _ = self._pad_rows(rows, lengths, 1, sb)
+        with self._mesh_ctx():
+            cache = self._fns[fkey](self.params, prompt_op, jnp.int32(s))
+        with self._prefix_lock:
+            self._prefixes[key] = (cache, s)
+            while len(self._prefixes) > self._prefix_cache_max:
+                self._prefixes.popitem(last=False)
+        return key
+
+    def _generate_with_prefix(self, prefix_tokens, rows, lengths,
+                              max_new_tokens, temperature, top_k, top_p,
+                              seed, eos_id):
+        """Continue-prefill + decode from a cached prefix KV (batch 1).
+        Output is exactly `generate(prefix + suffix)` — the suffix chunk
+        attends the cached prefix through the same masked-attention core,
+        so masked-out padding contributes exact zeros either way."""
+        import numpy as np
+
+        cfg = self.model.cfg
+        if len(rows) != 1:
+            raise ValueError("prefix= requires a single prompt row")
+        # (re)ensure + fetch atomically: a concurrent burst of distinct
+        # prefixes may evict this one between ensure and lookup — retry,
+        # don't 500
+        entry = None
+        for _ in range(3):
+            key = self.cache_prefix(prefix_tokens)  # idempotent fast path
+            with self._prefix_lock:
+                entry = self._prefixes.get(key)
+                if entry is not None:
+                    self._prefixes.move_to_end(key)
+                    break
+        if entry is None:
+            raise RuntimeError(
+                "prefix cache thrashing: entry evicted immediately after "
+                "insert 3x; raise prefix_cache_max")
+        cache, plen = entry
+        s = lengths[0]
+        self._validate(plen + s, max_new_tokens)
+        steps = min(_next_bucket(max_new_tokens, self.min_bucket),
+                    self.decode_cap, cfg.max_len - plen - s)
+        sbs = min(_next_bucket(s, self.min_bucket),
+                  cfg.max_len - plen - steps)
+        cache_len = cache[0]["k"].shape[1]
+        fkey = ("continue", sbs, steps, cache_len)
+        if fkey not in self._fns:
+            def fn(params, cache, suffix, suffix_len, temperature, top_k,
+                   top_p, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                idx = cache[0]["index"]
+                positions = (idx + jnp.arange(sbs))[None, :]
+                logits, new_cache = self.model.apply(
+                    params, suffix, positions=positions, cache=cache,
+                    logit_positions=jnp.broadcast_to(suffix_len - 1, (1,)))
+                start = idx + suffix_len
+                for entry in new_cache:
+                    entry["index"] = start
+                rng, sub = jax.random.split(rng)
+                first = select(logits[:, 0, :].astype(jnp.float32), sub)
+                done0 = (eos_id >= 0) & (first == eos_id)
+                return _scan_decode(self.model, params, select, first,
+                                    new_cache, start, done0, rng, eos_id,
+                                    steps)
+
+            self._fns[fkey] = jax.jit(fn)
+        suffix_op, _ = self._pad_rows(rows, lengths, 1, sbs)
+        args = (self.params, cache, suffix_op, jnp.int32(s),
+                *self._knob_operands(temperature, top_k, top_p, seed, eos_id))
+        with self._mesh_ctx():
+            out = self._fns[fkey](*args)
+        return np.asarray(jax.device_get(out))[:, :max_new_tokens]
 
     def _stream_fns(self, b: int, sb: int, cache_len: int, segment: int):
         """Compiled (prefill, segment) pair for streaming. The prefill

@@ -405,15 +405,35 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         if sample_kwargs["eos_id"] is None and from_text and \
                 tokenizer.eos_token_id is not None:
             sample_kwargs["eos_id"] = int(tokenizer.eos_token_id)
-        return prompt, max_new, sample_kwargs, from_text
+        prefix = req.get("prefix")
+        if prefix is not None:
+            prefix = np.asarray(prefix, np.int32).reshape(-1)
+            if prefix.size == 0:
+                return {"ok": False, "error": "empty prefix"}
+            if server is None:
+                return {"ok": False, "error":
+                        "prefix caching needs the compile-once server"}
+            if len(prompt) != 1:
+                return {"ok": False,
+                        "error": "prefix caching is single-row"}
+        return prompt, max_new, sample_kwargs, from_text, prefix
 
     def invoke(req: dict) -> dict:
         parsed = _parse(req)
         if isinstance(parsed, dict):
             return parsed
-        prompt, max_new, sample_kwargs, from_text = parsed
-        toks = np.asarray(jax.device_get(run(prompt, max_new, sample_kwargs)))
+        prompt, max_new, sample_kwargs, from_text, prefix = parsed
+        if prefix is not None:
+            # shared-prefix KV reuse: only the suffix prefills per request
+            toks = np.asarray(server.generate(
+                prompt, max_new_tokens=max_new, prefix=prefix,
+                **sample_kwargs))
+        else:
+            toks = np.asarray(
+                jax.device_get(run(prompt, max_new, sample_kwargs)))
         out = {"ok": True, "tokens": toks.tolist(), "n_new": int(toks.shape[-1])}
+        if prefix is not None:
+            out["prefix_cached"] = True
         if from_text:
             row = toks[0].tolist()
             eos = sample_kwargs["eos_id"]
@@ -430,7 +450,14 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         if isinstance(parsed, dict):
             yield parsed
             return
-        prompt, max_new, sample_kwargs, from_text = parsed
+        prompt, max_new, sample_kwargs, from_text, prefix = parsed
+        if prefix is not None:
+            # streaming doesn't thread the prefix cache (yet): decode the
+            # concatenated prompt — correct, just without the KV reuse
+            prompt = [np.concatenate([prefix,
+                                      np.asarray(r, np.int32).reshape(-1)])
+                      for r in (prompt if isinstance(prompt, list)
+                                else list(prompt))]
         # clamp the client's segment size to a pow-2 in [4, 64]: it is
         # part of the compiled-program key, and an arbitrary per-request
         # value would grow the program cache (and pay a compile) without
@@ -446,6 +473,10 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             yield {"ok": True, "tokens": chunk.tolist()}
         n_new = 0 if all_rows is None else int(all_rows.shape[1])
         out = {"ok": True, "done": True, "n_new": n_new}
+        if prefix is not None:
+            # the streaming path decoded the concatenated prompt — say so
+            # instead of letting clients assume the KV reuse happened
+            out["prefix_cached"] = False
         if from_text and all_rows is not None:
             row = all_rows[0].tolist()
             eos = sample_kwargs["eos_id"]

@@ -339,6 +339,34 @@ def test_generate_handler_ragged_json_rows(llama_bundle):
     assert not empty["ok"] and "empty" in empty["error"]
 
 
+def test_generate_handler_prefix_caching(llama_bundle):
+    """`prefix` requests reuse the cached prefix KV and match the
+    concatenated-prompt response; streamed prefix requests fall back to
+    concatenation with identical tokens."""
+    import numpy as np
+
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    report = load_bundle(llama_bundle)
+    prefix, suffix = [1, 2, 3, 4, 5, 6, 7], [9, 8]
+    full = report.handler.invoke(report.state,
+                                 {"tokens": prefix + suffix,
+                                  "max_new_tokens": 6})
+    via = report.handler.invoke(report.state,
+                                {"prefix": prefix, "tokens": suffix,
+                                 "max_new_tokens": 6})
+    assert via["ok"] and via["prefix_cached"], via
+    assert via["tokens"] == full["tokens"]
+    chunks = list(report.state.invoke_stream(
+        {"prefix": prefix, "tokens": suffix, "max_new_tokens": 6}))
+    streamed = [t for c in chunks if c.get("ok") and "tokens" in c
+                for t in c["tokens"][0]]
+    assert streamed == full["tokens"][0]
+    bad = report.handler.invoke(report.state,
+                                {"prefix": [], "tokens": suffix})
+    assert not bad["ok"]
+
+
 def test_generate_handler_serves_compile_once(llama_bundle):
     """The handler routes through LlamaServer: varied lengths and knobs in
     one bucket reuse a single compiled program."""

@@ -123,6 +123,42 @@ def test_filter_logits_runtime_matches_static():
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out))
 
 
+def test_prefix_cache_matches_full_prompt(tiny_llama):
+    """Decoding a suffix against a cached prefix KV equals decoding the
+    concatenated prompt — greedy and seeded-sampled — and the second
+    prefix request reuses both the KV entry and the compiled programs."""
+    import numpy as np
+
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params = tiny_llama
+    server = LlamaServer(adapter.module, params)
+    prefix = list(range(1, 20))  # a 19-token "system prompt"
+    for kw in ({}, dict(temperature=0.8, top_k=5, seed=11)):
+        for suffix in ([33, 34, 35], [40, 41, 42, 43, 44, 45]):
+            full = server.generate(prefix + suffix, max_new_tokens=8, **kw)
+            via_cache = server.generate(suffix, max_new_tokens=8,
+                                        prefix=prefix, **kw)
+            np.testing.assert_array_equal(via_cache, full)
+    assert len(server._prefixes) == 1  # one prefix entry, reused
+    count = server.compile_count
+    server.generate([50, 51], max_new_tokens=8, prefix=prefix)
+    assert server.compile_count == count  # zero new compiles on reuse
+
+
+def test_prefix_cache_lru_eviction(tiny_llama):
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params = tiny_llama
+    server = LlamaServer(adapter.module, params, prefix_cache_max=2)
+    k1 = server.cache_prefix([1, 2, 3])
+    k2 = server.cache_prefix([4, 5, 6])
+    server.cache_prefix([1, 2, 3])  # refresh k1
+    k3 = server.cache_prefix([7, 8, 9])  # evicts k2
+    assert set(server._prefixes) == {k1, k3}
+    assert server.cache_prefix([1, 2, 3]) == k1
+
+
 def test_stream_matches_fused_generate(tiny_llama):
     """Concatenated generate_stream chunks are exactly the fused generate
     output — greedy and seeded-sampled, rectangular and ragged — and the
