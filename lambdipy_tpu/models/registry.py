@@ -278,6 +278,9 @@ def _llama_overrides(extra: dict | None) -> dict:
     if out.get("matmul_backend", "xla") not in _MATMUL_BACKENDS:
         raise ValueError(f"unknown matmul_backend {out['matmul_backend']!r}; "
                          f"supported: {_MATMUL_BACKENDS}")
+    if out.get("kv_quant") not in (None, "int8"):
+        raise ValueError(f"unknown kv_quant {out['kv_quant']!r}; "
+                         "supported: int8 (or omit for the float cache)")
     return out
 
 

@@ -284,8 +284,10 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     batcher = None
     if adapter.make_server is not None:
         cap = extra.get("decode_cap")  # None = full context window
-        server = adapter.make_server(
-            params, mesh=mesh, decode_cap=int(cap) if cap else None)
+        server_caps = {"decode_cap": int(cap) if cap else None}
+        if extra.get("prefix_cache_max"):  # operators serving many prefixes
+            server_caps["prefix_cache_max"] = int(extra["prefix_cache_max"])
+        server = adapter.make_server(params, mesh=mesh, **server_caps)
         window_ms = float(extra.get("batch_window_ms", 0) or 0)
         if window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher

@@ -178,6 +178,45 @@ def test_flatpack_roundtrip_dtypes(tmp_path):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(leaf))
 
 
+def test_int8_kv_cache_decode_close_to_float(tmp_path):
+    """kv_quant='int8' halves decode-cache HBM; its decode-step logits
+    must stay within quantization tolerance of the float cache, and the
+    full serve path (ragged rows, streaming) must run on it."""
+    import dataclasses
+
+    from lambdipy_tpu.models.llama import (
+        LLAMA_TINY, LlamaModel, LlamaServer, prefill_into_cache)
+
+    base = dataclasses.replace(LLAMA_TINY)
+    quant = dataclasses.replace(LLAMA_TINY, kv_quant="int8")
+    mf, mq = LlamaModel(base), LlamaModel(quant)
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7]], jnp.int32)
+    params = mf.init(jax.random.PRNGKey(0), prompt)
+
+    logits_f, pc_f = mf.apply(params, prompt)
+    logits_q, pc_q = mq.apply(params, prompt)
+    np.testing.assert_array_equal(np.asarray(logits_f), np.asarray(logits_q))
+
+    step = jnp.asarray([[9]], jnp.int32)
+    pos = jnp.asarray([[7]], jnp.int32)
+    out = {}
+    for name, (m, pc) in {"f": (mf, pc_f), "q": (mq, pc_q)}.items():
+        cache = prefill_into_cache(m.cfg, pc, 1, 32, 7)
+        lg, _ = m.apply(params, step, positions=pos, cache=cache)
+        out[name] = np.asarray(lg[0, 0], np.float32)
+    err = np.abs(out["f"] - out["q"]).max() / max(1e-6, np.abs(out["f"]).max())
+    assert err < 0.05, err
+
+    server = LlamaServer(mq, params)
+    ragged = server.generate([[1, 2, 3], [4, 5, 6, 7, 8]], max_new_tokens=6)
+    assert ragged.shape == (2, 6)
+    chunks = list(server.generate_stream([1, 2, 3], max_new_tokens=6,
+                                         segment=2))
+    assert sum(c.shape[1] for c in chunks) == 6
+    via_prefix = server.generate([9, 9], max_new_tokens=4, prefix=[1, 2, 3])
+    assert via_prefix.shape == (1, 4)
+
+
 def test_params_format_fpk_only(tmp_path):
     """params_format='fpk' writes only the flat file (big payloads must
     not ship their dominant bytes twice) and load_params still serves."""
