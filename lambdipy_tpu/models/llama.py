@@ -18,6 +18,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any
 
 import jax
@@ -699,7 +700,6 @@ class LlamaServer:
         # from a cached prefix never copies or locks it — each request's
         # programs produce fresh buffers. LRU-bounded: a full-window
         # cache entry is max_len * kv_heads * head_dim * 2 * layers bytes.
-        import threading
         from collections import OrderedDict
 
         self._prefix_cache_max = max(1, prefix_cache_max)
@@ -846,8 +846,6 @@ class LlamaServer:
         Returns the cache key. The stored cache is sized to the full
         context window so any suffix + decode the window allows can
         continue from it."""
-        import numpy as np
-
         cfg = self.model.cfg
         rows, lengths = self._normalize_prompts(prefix_tokens)
         if len(rows) != 1:
@@ -855,8 +853,6 @@ class LlamaServer:
         s = lengths[0]
         if s >= cfg.max_len:
             raise ValueError(f"prefix {s} fills the whole context window")
-        import threading
-
         key = self._prefix_key(rows[0])
         while True:
             with self._prefix_lock:

@@ -285,7 +285,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     if adapter.make_server is not None:
         cap = extra.get("decode_cap")  # None = full context window
         server_caps = {"decode_cap": int(cap) if cap else None}
-        if extra.get("prefix_cache_max"):  # operators serving many prefixes
+        if extra.get("prefix_cache_max") is not None:
+            # operators serving many (or deliberately few) prefixes; an
+            # explicit 0 means "smallest" (the server clamps to 1)
             server_caps["prefix_cache_max"] = int(extra["prefix_cache_max"])
         server = adapter.make_server(params, mesh=mesh, **server_caps)
         window_ms = float(extra.get("batch_window_ms", 0) or 0)
