@@ -435,7 +435,14 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         else:
             toks = np.asarray(
                 jax.device_get(run(prompt, max_new, sample_kwargs)))
-        out = {"ok": True, "tokens": toks.tolist(), "n_new": int(toks.shape[-1])}
+        out = {"ok": True, "tokens": toks.tolist(), "n_new": int(toks.shape[-1]),
+               # effective request metadata for API shims (/v1/completions):
+               # the real prompt token count and the eos actually in force
+               # (a text prompt inherits the tokenizer's)
+               "n_prompt": int(sum(len(r) for r in prompt)
+                               + (len(prefix) if prefix is not None else 0))}
+        if sample_kwargs["eos_id"] is not None:
+            out["eos_id"] = sample_kwargs["eos_id"]
         if prefix is not None:
             out["prefix_cached"] = True
         if from_text:
@@ -476,7 +483,10 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                         else np.concatenate([all_rows, chunk], axis=1))
             yield {"ok": True, "tokens": chunk.tolist()}
         n_new = 0 if all_rows is None else int(all_rows.shape[1])
-        out = {"ok": True, "done": True, "n_new": n_new}
+        out = {"ok": True, "done": True, "n_new": n_new,
+               "n_prompt": int(sum(len(r) for r in prompt))}
+        if sample_kwargs["eos_id"] is not None:
+            out["eos_id"] = sample_kwargs["eos_id"]
         if prefix is not None:
             # the streaming path decoded the concatenated prompt — say so
             # instead of letting clients assume the KV reuse happened

@@ -30,6 +30,66 @@ from lambdipy_tpu.utils.logs import get_logger, log_event
 log = get_logger("lambdipy.server")
 
 
+def _openai_to_internal(req: dict) -> tuple[dict, str | None]:
+    """Translate an OpenAI /v1/completions body into the generate
+    handler's request shape. ``prompt`` may be a string (bundle tokenizer
+    required) or an int token array (tokenizer-free). OpenAI sampling
+    defaults apply: temperature/top_p default to 1.0 (sampled) — send
+    temperature 0 for greedy."""
+    prompt = req.get("prompt")
+    internal: dict = {}
+    if isinstance(prompt, str):
+        internal["text"] = prompt
+    elif isinstance(prompt, list) and prompt and \
+            all(isinstance(t, int) for t in prompt):
+        internal["tokens"] = prompt
+    else:
+        return {}, "prompt must be a string or an array of token ids"
+    if req.get("stop") is not None:
+        return {}, "stop sequences are not supported; pass eos_id"
+    if req.get("n", 1) != 1:
+        return {}, "n > 1 is not supported"
+    try:
+        if req.get("max_tokens") is not None:
+            internal["max_new_tokens"] = int(req["max_tokens"])
+        internal["temperature"] = float(req.get("temperature", 1.0))
+        internal["top_p"] = float(req.get("top_p", 1.0))
+    except (TypeError, ValueError) as e:
+        return {}, f"max_tokens/temperature/top_p must be numbers: {e}"
+    for knob in ("top_k", "seed", "eos_id", "prefix", "segment"):
+        if req.get(knob) is not None:
+            internal[knob] = req[knob]
+    internal["stream"] = bool(req.get("stream"))
+    return internal, None
+
+
+def _internal_to_openai(internal: dict, result: dict) -> dict:
+    row = list((result.get("tokens") or [[]])[0])
+    # the handler reports the EFFECTIVE eos (a string prompt inherits the
+    # tokenizer's) and the real prompt token count; fall back to what the
+    # request carried
+    eos = result.get("eos_id", internal.get("eos_id"))
+    finish = "length"
+    if eos is not None and eos in row:
+        # eos latching pads the row to the full decode width — trim so
+        # tokens and usage reflect what was actually generated
+        row = row[: row.index(eos) + 1]
+        finish = "stop"
+    n_prompt = int(result.get("n_prompt",
+                              len(internal.get("tokens") or [])))
+    choice = {"index": 0, "text": result.get("completion", ""),
+              "tokens": row, "finish_reason": finish,
+              "logprobs": None}
+    return {
+        "object": "text_completion",
+        "model": "lambdipy-bundle",
+        "choices": [choice],
+        "usage": {"prompt_tokens": n_prompt,
+                  "completion_tokens": len(row),
+                  "total_tokens": n_prompt + len(row)},
+    }
+
+
 class BundleServer:
     def __init__(self, bundle_dir: Path, host: str = "127.0.0.1", port: int = 0,
                  *, warmup: bool = True):
@@ -102,7 +162,25 @@ class BundleServer:
                     self._send(400, {"ok": False, "error": f"bad request: {e}"})
                     return None
 
+            def _begin_invoke(self) -> bool:
+                """Draining check + in-flight increment as one atomic
+                step: stop() can then never observe inflight==0 while an
+                accepted invoke is still on its way to dispatch. False =
+                draining (caller sends its 503/error)."""
+                with server_self._inflight_lock:
+                    draining = server_self.draining
+                    if not draining:
+                        server_self._inflight += 1
+                return not draining
+
+            def _end_invoke(self) -> None:
+                with server_self._inflight_lock:
+                    server_self._inflight -= 1
+
             def do_POST(self):
+                if self.path == "/v1/completions":
+                    self._openai_completions()
+                    return
                 if self.path == "/profile":
                     req = self._read_json()
                     if req is None:
@@ -149,14 +227,7 @@ class BundleServer:
                 if request is None:
                     server_self.stats.record_error()
                     return
-                # draining check and in-flight increment are one atomic
-                # step: stop() can then never observe inflight==0 while an
-                # accepted invoke is still on its way to dispatch
-                with server_self._inflight_lock:
-                    draining = server_self.draining
-                    if not draining:
-                        server_self._inflight += 1
-                if draining:
+                if not self._begin_invoke():
                     self._send(503, {"ok": False, "error": "draining"})
                     return
                 t0 = time.monotonic()
@@ -184,8 +255,130 @@ class BundleServer:
                     server_self.stats.record((time.monotonic() - t0) * 1e3)
                     self._send(200, result)
                 finally:
-                    with server_self._inflight_lock:
-                        server_self._inflight -= 1
+                    self._end_invoke()
+
+            def _openai_completions(self):
+                """OpenAI-compatible shim over the generate handler:
+                "prompt" may be a string (needs the bundle tokenizer) or
+                a token array (works without one). Shares the /invoke
+                drain bracket — graceful shutdown waits for these too."""
+                req = self._read_json()
+                if req is None:
+                    server_self.stats.record_error()
+                    return
+                internal, err = _openai_to_internal(req)
+                if err is not None:
+                    self._send(400, {"error": {"message": err,
+                                               "type": "invalid_request_error"}})
+                    return
+                if not self._begin_invoke():
+                    self._send(503, {"error": {"message": "draining",
+                                               "type": "unavailable"}})
+                    return
+                try:
+                    if internal.pop("stream", False):
+                        state = server_self.boot.state
+                        if getattr(state, "invoke_stream_fn", None) is None:
+                            self._send(400, {"error": {
+                                "message": "handler does not support streaming",
+                                "type": "invalid_request_error"}})
+                            return
+                        self._send_sse(state.invoke_stream, internal)
+                        return
+                    t0 = time.monotonic()
+                    try:
+                        result = server_self.boot.handler.invoke(
+                            server_self.boot.state, internal)
+                    except Exception as e:
+                        server_self.stats.record_error()
+                        self._send(500, {"error": {"message": str(e),
+                                                   "type": type(e).__name__}})
+                        return
+                    if not result.get("ok"):
+                        server_self.stats.record_error()
+                        self._send(400, {"error": {
+                            "message": result.get("error", "invoke failed"),
+                            "type": "invalid_request_error"}})
+                        return
+                    server_self.stats.record((time.monotonic() - t0) * 1e3)
+                    self._send(200, _internal_to_openai(internal, result))
+                finally:
+                    self._end_invoke()
+
+            def _write_frame(self, body: bytes) -> bool:
+                """One chunked-transfer frame; False = client went away
+                (recorded on the connection, never raised — the failure
+                mode of a streaming response IS the socket)."""
+                try:
+                    self.wfile.write(f"{len(body):x}\r\n".encode())
+                    self.wfile.write(body)
+                    self.wfile.write(b"\r\n")
+                    return True
+                except OSError:
+                    self.close_connection = True
+                    return False
+
+            def _end_frames(self) -> None:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    self.close_connection = True
+
+            def _send_sse(self, stream_invoke, internal: dict):
+                """OpenAI-style server-sent events: one `data:` event per
+                decode segment, closed by `data: [DONE]`. The final
+                summary record becomes a last event carrying the decoded
+                ``text`` (string prompts) and ``finish_reason``."""
+                t0 = time.monotonic()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def event(obj) -> bool:
+                    body = b"data: " + (obj if isinstance(obj, bytes)
+                                        else json.dumps(obj).encode()) + b"\n\n"
+                    return self._write_frame(body)
+
+                def chunk_event(tokens, text="", finish=None) -> bool:
+                    return event({"object": "text_completion.chunk",
+                                  "model": "lambdipy-bundle",
+                                  "choices": [{"index": 0, "text": text,
+                                               "tokens": tokens,
+                                               "finish_reason": finish}]})
+
+                emitted: list = []
+                final = None
+                try:
+                    for payload in stream_invoke(internal):
+                        if not payload.get("ok"):
+                            server_self.stats.record_error()
+                            event({"error": {"message": payload.get("error"),
+                                             "type": "invoke_error"}})
+                            self._end_frames()
+                            return
+                        if payload.get("done"):
+                            final = payload
+                            continue
+                        emitted.extend(payload["tokens"][0])
+                        if not chunk_event(payload["tokens"][0]):
+                            return
+                except Exception as e:
+                    server_self.stats.record_error()
+                    log_event(log, "sse invoke failed", error=str(e),
+                              kind=type(e).__name__)
+                    event({"error": {"message": str(e),
+                                     "type": type(e).__name__}})
+                    self._end_frames()
+                    return
+                eos = (final or {}).get("eos_id", internal.get("eos_id"))
+                finish = ("stop" if eos is not None and eos in emitted
+                          else "length")
+                chunk_event([], text=(final or {}).get("completion", ""),
+                            finish=finish)
+                server_self.stats.record((time.monotonic() - t0) * 1e3)
+                if event(b"[DONE]"):
+                    self._end_frames()
 
             def _send_stream(self, stream_fn, request: dict, t0: float):
                 """Chunked ndjson response: one JSON line per decode
@@ -198,35 +391,23 @@ class BundleServer:
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
 
-                def write_chunk(payload: dict):
-                    body = json.dumps(payload).encode() + b"\n"
-                    self.wfile.write(f"{len(body):x}\r\n".encode())
-                    self.wfile.write(body)
-                    self.wfile.write(b"\r\n")
+                def write_chunk(payload: dict) -> bool:
+                    return self._write_frame(json.dumps(payload).encode() + b"\n")
 
                 try:
                     for payload in stream_fn(request):
-                        write_chunk(payload)
+                        if not write_chunk(payload):
+                            return
                 except Exception as e:
                     server_self.stats.record_error()
                     log_event(log, "stream invoke failed", error=str(e),
                               kind=type(e).__name__)
-                    # the failure may BE the socket (client disconnected
-                    # mid-stream): the error chunk and terminator then
-                    # have nowhere to go — swallow, don't dump a second
-                    # traceback into http.server per disconnect
-                    try:
-                        write_chunk({"ok": False, "error": str(e),
-                                     "kind": type(e).__name__})
-                        self.wfile.write(b"0\r\n\r\n")
-                    except OSError:
-                        self.close_connection = True
+                    write_chunk({"ok": False, "error": str(e),
+                                 "kind": type(e).__name__})
+                    self._end_frames()
                     return
                 server_self.stats.record((time.monotonic() - t0) * 1e3)
-                try:
-                    self.wfile.write(b"0\r\n\r\n")
-                except OSError:
-                    self.close_connection = True
+                self._end_frames()
 
         return Handler
 

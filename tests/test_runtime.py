@@ -281,6 +281,76 @@ def test_generate_handler_null_knobs(llama_bundle):
     assert out["ok"] and out["n_new"] == 4  # bundle default_new
 
 
+def test_openai_completions_endpoint(llama_bundle):
+    """/v1/completions serves OpenAI-shaped requests over the generate
+    handler: token-array prompts work without a tokenizer, greedy matches
+    /invoke, eos sets finish_reason, bad requests get OpenAI-style
+    errors, and stream=true emits SSE events closed by [DONE]."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    server = BundleServer(llama_bundle, warmup=False).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(path, payload, timeout=60):
+        req = urllib.request.Request(
+            f"{base}{path}", data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    try:
+        plain = _post(f"{base}/invoke",
+                      {"tokens": [1, 2, 3], "max_new_tokens": 6})
+        with post("/v1/completions", {"prompt": [1, 2, 3], "max_tokens": 6,
+                                      "temperature": 0}) as resp:
+            body = _json.loads(resp.read())
+        assert body["object"] == "text_completion"
+        choice = body["choices"][0]
+        assert choice["tokens"] == plain["tokens"][0]
+        assert choice["finish_reason"] == "length"
+        assert body["usage"] == {"prompt_tokens": 3, "completion_tokens": 6,
+                                 "total_tokens": 9}
+        # eos latching -> finish_reason stop
+        eos = plain["tokens"][0][1]
+        with post("/v1/completions", {"prompt": [1, 2, 3], "max_tokens": 6,
+                                      "temperature": 0, "eos_id": eos}) as resp:
+            body = _json.loads(resp.read())
+        assert body["choices"][0]["finish_reason"] == "stop"
+        # string prompt without a tokenizer -> 400 with OpenAI error shape
+        try:
+            post("/v1/completions", {"prompt": "hello", "max_tokens": 4})
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in _json.loads(e.read())
+        # SSE streaming
+        with post("/v1/completions", {"prompt": [1, 2, 3], "max_tokens": 6,
+                                      "temperature": 0, "stream": True,
+                                      "segment": 4}) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            events = [ln.decode().strip()[len("data: "):]
+                      for ln in resp if ln.strip().startswith(b"data: ")]
+        assert events[-1] == "[DONE]"
+        toks = [t for e in events[:-1]
+                for t in _json.loads(e)["choices"][0]["tokens"]]
+        assert toks == plain["tokens"][0]
+        # the shim shares /invoke's drain bracket: no new work while draining
+        server.draining = True
+        try:
+            post("/v1/completions", {"prompt": [1], "max_tokens": 1})
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        finally:
+            server.draining = False
+    finally:
+        threading.Thread(target=server.stop, daemon=True).start()
+
+
 def test_http_streaming_invoke(llama_bundle):
     """`stream: true` returns chunked ndjson whose concatenated tokens
     equal the non-streamed response; non-stream requests still work on
