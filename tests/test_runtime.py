@@ -281,6 +281,39 @@ def test_generate_handler_null_knobs(llama_bundle):
     assert out["ok"] and out["n_new"] == 4  # bundle default_new
 
 
+def test_background_bucket_warm(tmp_path):
+    """warm_buckets pre-compiles the listed prompt buckets on a daemon
+    thread after init: once done, a first request in that bucket triggers
+    zero new compiles, and progress is visible through stats()."""
+    import time as _time
+
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "4", "warm_buckets": "64"})
+    report = load_bundle(bundle, warmup=False)
+    # the warm thread starts only after the FIRST invoke completes (so it
+    # can never contend with the boot warmup); trigger it
+    assert report.state.stats().get("warm_buckets", {}).get("done") in ([], None)
+    assert report.handler.invoke(report.state, {"tokens": [1, 2]})["ok"]
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline:
+        wb = report.state.stats().get("warm_buckets", {})
+        assert not wb.get("errors"), wb
+        if wb.get("done") == [64]:
+            break
+        _time.sleep(0.5)
+    else:
+        raise AssertionError(f"bucket warm never finished: {report.state.stats()}")
+    count = report.state.stats()["compile_count"]
+    out = report.handler.invoke(report.state, {
+        "tokens": list(range(1, 51)), "max_new_tokens": 4})  # 50 -> bucket 64
+    assert out["ok"]
+    assert report.state.stats()["compile_count"] == count  # warm hit
+
+
 def test_openai_completions_endpoint(llama_bundle):
     """/v1/completions serves OpenAI-shaped requests over the generate
     handler: token-array prompts work without a tokenizer, greedy matches
