@@ -557,22 +557,24 @@ def filter_logits_runtime(logits, top_k, top_p):
     return jnp.where((top_p < 1.0) & (logits < thresh), neg, logits)
 
 
-def _scan_decode(model: LlamaModel, params, select_fn, first, cache, start,
-                 done0, rng, eos_id, decode_steps: int,
+def _scan_decode(model: LlamaModel, params, select_fn, first, lp0, cache,
+                 start, done0, rng, eos_id, decode_steps: int,
                  return_carry: bool = False):
     """The decode scan shared by the exact-shape path (:func:`_decode`),
     the bucketed serving path (:func:`_serve_decode`) and the streaming
     segment path: one compiled step per token over a static-shape cache.
     ``eos_id`` is an int32 operand; < 0 disables eos latching (``done``
     then never becomes True, so the filler value is never emitted).
-    ``return_carry`` additionally returns the final (tok, cache, pos,
-    done, rng) carry so a later segment can continue the decode exactly
-    where this one stopped."""
+    Emits ``(tokens, logprobs)`` — each token's raw model logprob rides
+    along (one logsumexp per step, noise next to the forward); filler
+    tokens after eos carry logprob 0. ``return_carry`` additionally
+    returns the final (tok, lp, cache, pos, done, rng) carry so a later
+    segment can continue the decode exactly where this one stopped."""
     b = first.shape[0]
     has_eos = eos_id >= 0
 
     def step(carry, _):
-        tok, cache, pos, done, rng = carry  # pos: int32 scalar or [b]
+        tok, lp, cache, pos, done, rng = carry  # pos: int32 scalar or [b]
         positions = (pos[:, None] if jnp.ndim(pos)
                      else jnp.broadcast_to(pos[None, None], (b, 1)))
         logits, new_cache = model.apply(params, tok[:, None],
@@ -580,15 +582,17 @@ def _scan_decode(model: LlamaModel, params, select_fn, first, cache, start,
         for entry in new_cache:
             entry["index"] = pos + 1
         rng, sub = jax.random.split(rng)
-        nxt = select_fn(logits[:, -1, :].astype(jnp.float32), sub)
+        nxt, nlp = select_fn(logits[:, -1, :].astype(jnp.float32), sub)
         nxt = jnp.where(done, eos_id, nxt)
+        nlp = jnp.where(done, jnp.float32(0.0), nlp)
         done = done | (has_eos & (nxt == eos_id))
-        return (nxt, new_cache, pos + 1, done, rng), tok
+        return (nxt, nlp, new_cache, pos + 1, done, rng), (tok, lp)
 
-    carry, toks = jax.lax.scan(step, (first, cache, start, done0, rng), None,
-                               length=decode_steps)
-    toks = jnp.transpose(toks)  # [b, decode_steps]
-    return (toks, carry) if return_carry else toks
+    carry, (toks, lps) = jax.lax.scan(
+        step, (first, lp0, cache, start, done0, rng), None,
+        length=decode_steps)
+    out = (jnp.transpose(toks), jnp.transpose(lps))  # [b, decode_steps] x2
+    return (out, carry) if return_carry else out
 
 
 def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
@@ -616,8 +620,17 @@ def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
     return _scan_decode(model, params, select, *carry, eos_id, decode_steps)
 
 
+def _token_logprob(lg, tok):
+    """Raw model logprob of ``tok`` under fp32 logits ``lg`` [b, v] —
+    log_softmax at the chosen index (knob-independent: what the MODEL
+    assigned, not the sampling distribution)."""
+    logz = jax.nn.logsumexp(lg, axis=-1)
+    return jnp.take_along_axis(lg, tok[:, None], axis=-1)[:, 0] - logz
+
+
 def _serve_select(temperature, top_k, top_p):
-    """Token-selection closure over runtime knob operands."""
+    """Token-selection closure over runtime knob operands. Returns
+    ``(token, raw model logprob of token)``."""
 
     def select(lg, rng):
         lg = lg.astype(jnp.float32)
@@ -635,8 +648,9 @@ def _serve_select(temperature, top_k, top_p):
         # cond, not where: greedy requests (temperature <= 0) must not pay
         # the sampling path's two vocab-sized sorts per emitted token —
         # they dominate small-model decode steps
-        return jax.lax.cond(temperature > jnp.float32(0.0), sampled, greedy,
-                            (lg, rng))
+        tok = jax.lax.cond(temperature > jnp.float32(0.0), sampled, greedy,
+                           (lg, rng))
+        return tok, _token_logprob(lg, tok)
 
     return select
 
@@ -645,7 +659,8 @@ def _serve_prefill(model: LlamaModel, params, prompt, length, select, rng,
                    eos_id, *, cache_len: int):
     """Bucketed serving prefill: embed the prompt into a ``cache_len``
     decode cache and select the first token. Returns the decode carry
-    ``(first, cache, pos, done, rng)`` consumed by :func:`_scan_decode` —
+    ``(first, lp0, cache, pos, done, rng)`` consumed by
+    :func:`_scan_decode` —
     either fused into one program (:func:`_serve_decode`) or as its own
     compiled program for streaming segments."""
     cfg = model.cfg
@@ -659,9 +674,9 @@ def _serve_prefill(model: LlamaModel, params, prompt, length, select, rng,
     for entry in cache:
         entry["index"] = length
     rng, sub = jax.random.split(rng)
-    first = select(logits[:, 0, :].astype(jnp.float32), sub)
+    first, lp0 = select(logits[:, 0, :].astype(jnp.float32), sub)
     done0 = (eos_id >= 0) & (first == eos_id)
-    return first, cache, length, done0, rng
+    return first, lp0, cache, length, done0, rng
 
 
 def _next_bucket(n: int, lo: int) -> int:
@@ -789,7 +804,8 @@ class LlamaServer:
     def generate(self, prompt_tokens, *, max_new_tokens: int,
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None, seed: int = 0,
-                 eos_id: int | None = None, prefix=None):
+                 eos_id: int | None = None, prefix=None,
+                 return_logprobs: bool = False):
         """prompt_tokens: [s], [b, s], or a RAGGED list of rows with
         different lengths (each row decodes from its own prompt end) ->
         [b, max_new_tokens].
@@ -810,7 +826,7 @@ class LlamaServer:
         if prefix is not None:
             return self._generate_with_prefix(
                 prefix, rows, lengths, max_new_tokens, temperature, top_k,
-                top_p, seed, eos_id)
+                top_p, seed, eos_id, return_logprobs=return_logprobs)
         self._validate(s, max_new_tokens)
         # prefer power-of-two buckets for reuse, but shrink toward the
         # exact request near the max_len boundary instead of rejecting:
@@ -826,8 +842,12 @@ class LlamaServer:
         args = (self.params, prompt_op, length_op,
                 *self._knob_operands(temperature, top_k, top_p, seed, eos_id))
         with self._mesh_ctx():
-            out = fn(*args)
-        return np.asarray(jax.device_get(out))[:b, :max_new_tokens]
+            toks, lps = fn(*args)
+        toks = np.asarray(jax.device_get(toks))[:b, :max_new_tokens]
+        if return_logprobs:
+            lps = np.asarray(jax.device_get(lps))[:b, :max_new_tokens]
+            return toks, lps
+        return toks
 
     # -- prefix caching ------------------------------------------------------
 
@@ -903,7 +923,7 @@ class LlamaServer:
 
     def _generate_with_prefix(self, prefix_tokens, rows, lengths,
                               max_new_tokens, temperature, top_k, top_p,
-                              seed, eos_id):
+                              seed, eos_id, return_logprobs: bool = False):
         """Continue-prefill + decode from a cached prefix KV (batch 1).
         With the float cache, output is exactly `generate(prefix +
         suffix)` — the suffix chunk attends the cached prefix through the
@@ -952,9 +972,9 @@ class LlamaServer:
                 for entry in new_cache:
                     entry["index"] = start
                 rng, sub = jax.random.split(rng)
-                first = select(logits[:, 0, :].astype(jnp.float32), sub)
+                first, lp0 = select(logits[:, 0, :].astype(jnp.float32), sub)
                 done0 = (eos_id >= 0) & (first == eos_id)
-                return _scan_decode(self.model, params, select, first,
+                return _scan_decode(self.model, params, select, first, lp0,
                                     new_cache, start, done0, rng, eos_id,
                                     steps)
 
@@ -963,8 +983,11 @@ class LlamaServer:
         args = (self.params, cache, suffix_op, jnp.int32(s),
                 *self._knob_operands(temperature, top_k, top_p, seed, eos_id))
         with self._mesh_ctx():
-            out = self._fns[fkey](*args)
-        return np.asarray(jax.device_get(out))[:, :max_new_tokens]
+            toks, lps = self._fns[fkey](*args)
+        toks = np.asarray(jax.device_get(toks))[:, :max_new_tokens]
+        if return_logprobs:
+            return toks, np.asarray(jax.device_get(lps))[:, :max_new_tokens]
+        return toks
 
     def _stream_fns(self, b: int, sb: int, cache_len: int, segment: int):
         """Compiled (prefill, segment) pair for streaming. The prefill
@@ -981,11 +1004,11 @@ class LlamaServer:
                                       select, rng, eos_id,
                                       cache_len=cache_len)
 
-            def seg(params, temperature, top_k, top_p, first, cache, pos,
-                    done, rng, eos_id):
+            def seg(params, temperature, top_k, top_p, first, lp, cache,
+                    pos, done, rng, eos_id):
                 select = _serve_select(temperature, top_k, top_p)
-                return _scan_decode(self.model, params, select, first, cache,
-                                    pos, done, rng, eos_id, segment,
+                return _scan_decode(self.model, params, select, first, lp,
+                                    cache, pos, done, rng, eos_id, segment,
                                     return_carry=True)
 
             self._fns[key] = (jax.jit(prefill), jax.jit(seg))
@@ -994,13 +1017,15 @@ class LlamaServer:
     def generate_stream(self, prompt_tokens, *, max_new_tokens: int,
                         temperature: float = 0.0, top_k: int | None = None,
                         top_p: float | None = None, seed: int = 0,
-                        eos_id: int | None = None, segment: int = 16):
+                        eos_id: int | None = None, segment: int = 16,
+                        return_logprobs: bool = False):
         """Streaming :meth:`generate`: yields ``[b, k]`` numpy chunks
-        (k <= segment) as they decode, stopping early once every row has
-        latched eos. Concatenated chunks are EXACTLY the fused
-        ``generate`` output prefix — the segment boundaries don't change
-        the RNG walk, so a seeded sampled stream matches its non-streamed
-        twin token for token. Time-to-first-token is one prefill plus one
+        (k <= segment) as they decode — ``(tokens, logprobs)`` pairs when
+        ``return_logprobs`` — stopping early once every row has latched
+        eos. Concatenated chunks are EXACTLY the fused ``generate``
+        output prefix — the segment boundaries don't change the RNG
+        walk, so a seeded sampled stream matches its non-streamed twin
+        token for token. Time-to-first-token is one prefill plus one
         segment instead of the whole decode."""
         import numpy as np
 
@@ -1039,17 +1064,21 @@ class LlamaServer:
                             *knobs, key, eos)
             emitted = 0
             while emitted < max_new_tokens:
-                toks, carry = seg(self.params, *knobs, *carry, eos)
+                (toks, lps), carry = seg(self.params, *knobs, *carry, eos)
                 chunk = np.asarray(jax.device_get(toks))[:b]
                 take = min(chunk.shape[1], max_new_tokens - emitted)
                 emitted += take
-                yield chunk[:, :take]
+                if return_logprobs:
+                    lp_chunk = np.asarray(jax.device_get(lps))[:b]
+                    yield chunk[:, :take], lp_chunk[:, :take]
+                else:
+                    yield chunk[:, :take]
                 # all real rows latched eos -> nothing more can be
                 # emitted. Fetch the done flags only when eos is active:
                 # each fetch is a host round trip per segment, pure waste
                 # without an eos to latch.
                 if eos_id is not None:
-                    done = np.asarray(jax.device_get(carry[3]))[:b]
+                    done = np.asarray(jax.device_get(carry[4]))[:b]
                     if bool(done.all()):
                         return
 
@@ -1072,7 +1101,8 @@ class LlamaServer:
 def _decode(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
             max_len: int | None, select_fn, rng, eos_id: int | None):
     """Shared decode loop: prefill once, then ``lax.scan`` one compiled
-    step per token; ``select_fn(logits_f32, rng) -> next token ids``."""
+    step per token; ``select_fn(logits_f32, rng) -> (token ids, logprobs)``.
+    Returns token ids only (the legacy generate API)."""
     cfg = model.cfg
     b, s = prompt_tokens.shape
     max_len = max_len or min(cfg.max_len, s + max_new_tokens)
@@ -1082,11 +1112,12 @@ def _decode(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
         logit_positions=jnp.full((b,), s - 1, jnp.int32))
     cache = prefill_into_cache(cfg, prefill_cache, b, max_len, s)
     rng, sub = jax.random.split(rng)
-    first_token = select_fn(logits[:, -1, :].astype(jnp.float32), sub)
+    first_token, lp0 = select_fn(logits[:, -1, :].astype(jnp.float32), sub)
     eos = jnp.int32(-1 if eos_id is None else eos_id)
     done0 = (eos >= 0) & (first_token == eos)
-    return _scan_decode(model, params, select_fn, first_token, cache,
-                        jnp.int32(s), done0, rng, eos, max_new_tokens)
+    toks, _ = _scan_decode(model, params, select_fn, first_token, lp0, cache,
+                           jnp.int32(s), done0, rng, eos, max_new_tokens)
+    return toks
 
 
 def greedy_generate(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
@@ -1095,7 +1126,8 @@ def greedy_generate(model: LlamaModel, params, prompt_tokens, *, max_new_tokens:
     After ``eos_id`` (when given) a sequence keeps emitting ``eos_id``."""
 
     def select(logits, _rng):
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return tok, _token_logprob(logits, tok)
 
     return _decode(model, params, prompt_tokens, max_new_tokens=max_new_tokens,
                    max_len=max_len, select_fn=select,
@@ -1115,9 +1147,10 @@ def sample_generate(model: LlamaModel, params, prompt_tokens, *, rng,
                                eos_id=eos_id)
 
     def select(logits, rng):
-        logits = filter_logits(logits / jnp.float32(temperature),
-                               top_k=top_k, top_p=top_p)
-        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+        filt = filter_logits(logits / jnp.float32(temperature),
+                             top_k=top_k, top_p=top_p)
+        tok = jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
+        return tok, _token_logprob(logits, tok)
 
     return _decode(model, params, prompt_tokens, max_new_tokens=max_new_tokens,
                    max_len=max_len, select_fn=select, rng=rng, eos_id=eos_id)

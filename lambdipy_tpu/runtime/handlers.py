@@ -377,8 +377,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                                 **sample_kwargs)
 
     def _parse(req: dict):
-        """Request -> (prompt, max_new, sample_kwargs, from_text), or an
-        error dict (the shared front half of invoke and invoke_stream)."""
+        """Request -> (prompt, max_new, sample_kwargs, from_text, prefix,
+        want_logprobs), or an error dict (the shared front half of
+        invoke and invoke_stream)."""
         from_text = False
         if req.get("warmup") or req.get("random"):
             if req.get("warmup") and server is not None and batcher is not None:
@@ -461,7 +462,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             if len(prompt) != 1:
                 return {"ok": False,
                         "error": "prefix caching is single-row"}
-        return prompt, max_new, sample_kwargs, from_text, prefix
+        return (prompt, max_new, sample_kwargs, from_text, prefix,
+                bool(req.get("logprobs")))
 
     def invoke(req: dict) -> dict:
         parsed = _parse(req)
@@ -475,21 +477,35 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             _maybe_start_bucket_warm()
 
     def _invoke_parsed(parsed) -> dict:
-        prompt, max_new, sample_kwargs, from_text, prefix = parsed
+        prompt, max_new, sample_kwargs, from_text, prefix, want_lp = parsed
+        lps = None
+        if want_lp and server is None:
+            return {"ok": False,
+                    "error": "logprobs need the compile-once server"}
         if prefix is not None:
             # shared-prefix KV reuse: only the suffix prefills per request
-            toks = np.asarray(server.generate(
-                prompt, max_new_tokens=max_new, prefix=prefix,
-                **sample_kwargs))
+            out_ = server.generate(prompt, max_new_tokens=max_new,
+                                   prefix=prefix, return_logprobs=want_lp,
+                                   **sample_kwargs)
+            toks, lps = out_ if want_lp else (out_, None)
+        elif want_lp:
+            # logprobs ride the compile-once server path (solo: the fused
+            # program returns them alongside the tokens)
+            toks, lps = server.generate(prompt, max_new_tokens=max_new,
+                                        return_logprobs=True, **sample_kwargs)
         else:
             toks = np.asarray(
                 jax.device_get(run(prompt, max_new, sample_kwargs)))
+        toks = np.asarray(toks)
         out = {"ok": True, "tokens": toks.tolist(), "n_new": int(toks.shape[-1]),
                # effective request metadata for API shims (/v1/completions):
                # the real prompt token count and the eos actually in force
                # (a text prompt inherits the tokenizer's)
                "n_prompt": int(sum(len(r) for r in prompt)
                                + (len(prefix) if prefix is not None else 0))}
+        if lps is not None:
+            out["logprobs"] = [[round(float(x), 5) for x in row]
+                               for row in np.asarray(lps)]
         if sample_kwargs["eos_id"] is not None:
             out["eos_id"] = sample_kwargs["eos_id"]
         if prefix is not None:
@@ -510,7 +526,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         if isinstance(parsed, dict):
             yield parsed
             return
-        prompt, max_new, sample_kwargs, from_text, prefix = parsed
+        prompt, max_new, sample_kwargs, from_text, prefix, want_lp = parsed
         if prefix is not None:
             # streaming doesn't thread the prefix cache (yet): decode the
             # concatenated prompt — correct, just without the KV reuse
@@ -527,10 +543,17 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         segment = min(64, _next_bucket(max(4, int(req.get("segment") or 16)), 4))
         all_rows = None
         for chunk in server.generate_stream(prompt, max_new_tokens=max_new,
-                                            segment=segment, **sample_kwargs):
+                                            segment=segment,
+                                            return_logprobs=want_lp,
+                                            **sample_kwargs):
+            chunk, lp_chunk = chunk if want_lp else (chunk, None)
             all_rows = (chunk if all_rows is None
                         else np.concatenate([all_rows, chunk], axis=1))
-            yield {"ok": True, "tokens": chunk.tolist()}
+            rec = {"ok": True, "tokens": chunk.tolist()}
+            if lp_chunk is not None:
+                rec["logprobs"] = [[round(float(x), 5) for x in row]
+                                   for row in lp_chunk]
+            yield rec
         n_new = 0 if all_rows is None else int(all_rows.shape[1])
         out = {"ok": True, "done": True, "n_new": n_new,
                "n_prompt": int(sum(len(r) for r in prompt))}

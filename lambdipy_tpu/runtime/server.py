@@ -59,6 +59,15 @@ def _openai_to_internal(req: dict) -> tuple[dict, str | None]:
     for knob in ("top_k", "seed", "eos_id", "prefix", "segment"):
         if req.get(knob) is not None:
             internal[knob] = req[knob]
+    lp = req.get("logprobs")
+    if lp:
+        try:
+            if lp is not True and int(lp) > 1:
+                return {}, ("top_logprobs > 1 is not supported "
+                            "(send logprobs: 1)")
+        except (TypeError, ValueError):
+            return {}, "logprobs must be a boolean or small integer"
+        internal["logprobs"] = True
     internal["stream"] = bool(req.get("stream"))
     return internal, None
 
@@ -80,6 +89,11 @@ def _internal_to_openai(internal: dict, result: dict) -> dict:
     choice = {"index": 0, "text": result.get("completion", ""),
               "tokens": row, "finish_reason": finish,
               "logprobs": None}
+    if result.get("logprobs"):
+        lp_row = result["logprobs"][0][: len(row)]
+        choice["logprobs"] = {"tokens": [str(t) for t in row],
+                              "token_logprobs": lp_row,
+                              "top_logprobs": None, "text_offset": None}
     return {
         "object": "text_completion",
         "model": "lambdipy-bundle",
@@ -340,12 +354,18 @@ class BundleServer:
                                         else json.dumps(obj).encode()) + b"\n\n"
                     return self._write_frame(body)
 
-                def chunk_event(tokens, text="", finish=None) -> bool:
+                def chunk_event(tokens, text="", finish=None,
+                                logprobs=None) -> bool:
+                    choice = {"index": 0, "text": text, "tokens": tokens,
+                              "finish_reason": finish}
+                    if logprobs is not None:
+                        choice["logprobs"] = {
+                            "tokens": [str(t) for t in tokens],
+                            "token_logprobs": logprobs,
+                            "top_logprobs": None, "text_offset": None}
                     return event({"object": "text_completion.chunk",
                                   "model": "lambdipy-bundle",
-                                  "choices": [{"index": 0, "text": text,
-                                               "tokens": tokens,
-                                               "finish_reason": finish}]})
+                                  "choices": [choice]})
 
                 emitted: list = []
                 final = None
@@ -361,7 +381,10 @@ class BundleServer:
                             final = payload
                             continue
                         emitted.extend(payload["tokens"][0])
-                        if not chunk_event(payload["tokens"][0]):
+                        if not chunk_event(
+                                payload["tokens"][0],
+                                logprobs=(payload.get("logprobs") or
+                                          [None])[0]):
                             return
                 except Exception as e:
                     server_self.stats.record_error()

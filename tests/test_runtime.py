@@ -371,6 +371,31 @@ def test_openai_completions_endpoint(llama_bundle):
         toks = [t for e in events[:-1]
                 for t in _json.loads(e)["choices"][0]["tokens"]]
         assert toks == plain["tokens"][0]
+        # streamed logprobs ride each SSE chunk
+        with post("/v1/completions", {"prompt": [1, 2, 3], "max_tokens": 6,
+                                      "temperature": 0, "stream": True,
+                                      "segment": 4, "logprobs": 1}) as resp:
+            evs = [_json.loads(ln.decode().strip()[6:])
+                   for ln in resp if ln.strip().startswith(b"data: ")
+                   and not ln.strip().endswith(b"[DONE]")]
+        tok_evs = [e for e in evs if e["choices"][0]["tokens"]]
+        assert tok_evs, evs
+        for e in tok_evs:
+            ch = e["choices"][0]
+            assert len(ch["logprobs"]["token_logprobs"]) == len(ch["tokens"])
+        # logprobs: per-token model logprobs in OpenAI shape
+        with post("/v1/completions", {"prompt": [1, 2, 3], "max_tokens": 4,
+                                      "temperature": 0,
+                                      "logprobs": 1}) as resp:
+            body = _json.loads(resp.read())
+        lp = body["choices"][0]["logprobs"]
+        assert len(lp["token_logprobs"]) == len(body["choices"][0]["tokens"])
+        assert all(x <= 1e-6 for x in lp["token_logprobs"])
+        try:
+            post("/v1/completions", {"prompt": [1], "logprobs": 5})
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
         # the shim shares /invoke's drain bracket: no new work while draining
         server.draining = True
         try:

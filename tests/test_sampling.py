@@ -123,6 +123,44 @@ def test_filter_logits_runtime_matches_static():
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out))
 
 
+def test_logprobs_are_model_log_softmax(tiny_llama):
+    """return_logprobs yields each emitted token's raw model logprob:
+    greedy logprobs equal log_softmax at the argmax (checked against a
+    scoring forward), are <= 0, and ride every serving path (fused,
+    streamed, prefix) identically."""
+    import numpy as np
+
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params = tiny_llama
+    server = LlamaServer(adapter.module, params)
+    prompt = [1, 2, 3, 4, 5]
+    toks, lps = server.generate(prompt, max_new_tokens=6,
+                                return_logprobs=True)
+    assert toks.shape == lps.shape == (1, 6)
+    assert (lps <= 1e-6).all(), lps
+    # first emitted token's logprob == log_softmax of the scoring forward
+    # at the prompt's last position
+    logits = adapter.forward(params, jnp.asarray([prompt], jnp.int32))
+    ref = jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
+    np.testing.assert_allclose(float(lps[0, 0]), float(ref[toks[0, 0]]),
+                               rtol=1e-5, atol=1e-5)
+    # streamed logprobs match the fused ones
+    chunks = list(server.generate_stream(prompt, max_new_tokens=6, segment=2,
+                                         return_logprobs=True))
+    st = np.concatenate([c[0] for c in chunks], axis=1)
+    sl = np.concatenate([c[1] for c in chunks], axis=1)
+    np.testing.assert_array_equal(st, toks)
+    np.testing.assert_allclose(sl, lps, rtol=1e-5, atol=1e-6)
+    # prefix path carries them too
+    pt, pl = server.generate([4, 5], max_new_tokens=6, prefix=[1, 2, 3],
+                             return_logprobs=True)
+    ft, fl = server.generate([1, 2, 3, 4, 5], max_new_tokens=6,
+                             return_logprobs=True)
+    np.testing.assert_array_equal(pt, ft)
+    np.testing.assert_allclose(pl, fl, rtol=1e-5, atol=1e-6)
+
+
 def test_prefix_cache_matches_full_prompt(tiny_llama):
     """Decoding a suffix against a cached prefix KV equals decoding the
     concatenated prompt — greedy and seeded-sampled — and the second
