@@ -109,7 +109,30 @@ def _stage_matmul() -> int:
     return 0
 
 
+def _measure_rtt_ms(jax, jnp) -> float:
+    """Per-fetch transport floor: median ms to fetch a FRESH tiny device
+    result host-side (one network RTT through a remote PJRT tunnel, ~0 on
+    attached hardware)."""
+    import statistics
+
+    f = jax.jit(lambda x: (x * 2).sum())
+    xd = jax.device_put(jnp.ones((8, 8), jnp.float32))
+    float(f(xd))
+    return statistics.median([_timed(lambda: float(f(xd)))
+                              for _ in range(10)])
+
+
 def _stage_model() -> int:
+    """Headline: host-observed EXECUTION p50, net of the transport floor.
+
+    On this image's remote PJRT tunnel ``block_until_ready`` returns at
+    submission (~0.03 ms) without waiting for remote completion — only a
+    host fetch observes the device finish. So the headline times
+    ``jax.device_get`` of the output and subtracts the independently
+    measured per-fetch RTT floor; submission latency stays published as
+    ``submit_p50_ms``. On attached hardware the two converge (rtt ~0 and
+    block_until_ready is truthful). VERDICT r3 weak #1.
+    """
     import statistics
 
     _maybe_wedge("model")
@@ -117,6 +140,7 @@ def _stage_model() -> int:
     import jax.numpy as jnp
 
     from lambdipy_tpu.models import registry
+    from lambdipy_tpu.utils import roofline
 
     platform = devices[0].platform
     model = os.environ.get("LAMBDIPY_BENCH_MODEL", "resnet50")
@@ -127,29 +151,38 @@ def _stage_model() -> int:
     fwd = jax.jit(adapter.forward)
 
     t1 = time.monotonic()
-    jax.block_until_ready(fwd(params, x))
+    jax.device_get(fwd(params, x))
     compile_s = time.monotonic() - t1
 
     for _ in range(5):
-        jax.block_until_ready(fwd(params, x))
-    times = []
+        jax.device_get(fwd(params, x))
+    rtt = _measure_rtt_ms(jax, jnp) if platform != "cpu" else 0.0
     iters = 50 if platform != "cpu" else 10
-    for _ in range(iters):
-        t = time.monotonic()
-        jax.block_until_ready(fwd(params, x))
-        times.append((time.monotonic() - t) * 1000.0)
-    p50 = statistics.median(times)
+    exec_times = [_timed(lambda: jax.device_get(fwd(params, x)))
+                  for _ in range(iters)]
+    submit_times = [_timed(lambda: jax.block_until_ready(fwd(params, x)))
+                    for _ in range(iters)]
+    p50 = max(0.001, statistics.median(exec_times) - rtt)
 
-    print(json.dumps({
+    record = {
         "metric": f"{model}_b1_fwd_p50",
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(BASELINE_P50_MS / p50, 3),
+        "methodology": "host-observed execution time (device_get) minus "
+                       "measured per-fetch transport RTT floor",
+        "submit_p50_ms": round(statistics.median(submit_times), 3),
+        "fetch_rtt_ms": round(rtt, 2),
         "platform": platform,
         "n_devices": len(devices),
         "init_s": round(init_s, 2),
         "first_compile_s": round(compile_s, 2),
-    }))
+    }
+    if model == "resnet50":
+        cost = roofline.resnet50_cost(batch=1)
+        record.update({f"model_{k}": v
+                       for k, v in cost.utilization(p50 / 1e3).items()})
+    print(json.dumps(record))
     return 0
 
 
@@ -166,6 +199,7 @@ def _stage_decode() -> int:
     import jax.numpy as jnp
 
     from lambdipy_tpu.models import registry
+    from lambdipy_tpu.utils import roofline
 
     n_new = 64
     adapter = registry.get("llama3-8b").build(
@@ -177,22 +211,26 @@ def _stage_decode() -> int:
     prompt = [1, 2, 3, 4, 5, 6, 7, 8]
     server.generate(prompt, max_new_tokens=n_new)  # compile + warm
 
-    # per-fetch transport floor (one RTT through a remote tunnel, ~0 on
-    # attached hardware) — subtracted so tok/s measures the decode
-    f = jax.jit(lambda x: (x * 2).sum())
-    xd = jax.device_put(jnp.ones((8, 8), jnp.float32))
-    float(f(xd))
-    rtt = statistics.median(
-        [_timed(lambda: float(f(xd))) for _ in range(10)])
+    # transport floor subtracted so tok/s measures the decode
+    rtt = _measure_rtt_ms(jax, jnp)
     times = [_timed(lambda: server.generate(prompt, max_new_tokens=n_new))
              for _ in range(10)]
     net_ms = max(0.1, statistics.median(times) - rtt)
-    print(json.dumps({
+    # per-decoded-token utilization at the mean cache length of the run
+    cost = roofline.llama_decode_step_cost(
+        adapter.config, batch=1, cache_len=len(prompt) + n_new // 2)
+    record = {
         "decode_tok_s": round(n_new / (net_ms / 1e3), 1),
         "decode_net_ms": round(net_ms, 2),
         "decode_rtt_ms": round(rtt, 2),
         "decode_n_new": n_new,
-    }))
+        "decode_dims": f"{adapter.config.hidden}x{adapter.config.layers}"
+                       f"x{adapter.config.vocab_size}",
+    }
+    record.update({f"decode_{k}": v
+                   for k, v in cost.utilization(net_ms / n_new / 1e3).items()
+                   if k in ("mfu", "hbm_util", "roofline_ms")})
+    print(json.dumps(record))
     return 0
 
 

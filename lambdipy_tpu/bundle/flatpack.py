@@ -134,6 +134,17 @@ def save_checkpoint_files(params_dir: Path, params,
     if params_format in ("both", "fpk"):
         save(params_dir / "params.fpk", params)
         fmt.append("fpk")
+    else:
+        # rebuilding a params dir in place as orbax-only must not leave a
+        # stale params.fpk behind: the loader prefers the flat file, so a
+        # leftover one would silently serve the OLD weights
+        (params_dir / "params.fpk").unlink(missing_ok=True)
+    if params_format == "fpk" and (params_dir / "orbax").exists():
+        # mirror image: an fpk-only rebuild must not ship (or fall back
+        # to) a stale orbax checkpoint with the old weights
+        import shutil
+
+        shutil.rmtree(params_dir / "orbax")
     return "+".join(fmt)
 
 

@@ -368,6 +368,7 @@ class BundleServer:
                                   "choices": [choice]})
 
                 emitted: list = []
+                text_sent = ""
                 final = None
                 try:
                     for payload in stream_invoke(internal):
@@ -381,8 +382,12 @@ class BundleServer:
                             final = payload
                             continue
                         emitted.extend(payload["tokens"][0])
+                        # incremental text (string prompts): each chunk
+                        # carries the delta the handler decoded for it
+                        delta = payload.get("text", "")
+                        text_sent += delta
                         if not chunk_event(
-                                payload["tokens"][0],
+                                payload["tokens"][0], text=delta,
                                 logprobs=(payload.get("logprobs") or
                                           [None])[0]):
                             return
@@ -397,8 +402,19 @@ class BundleServer:
                 eos = (final or {}).get("eos_id", internal.get("eos_id"))
                 finish = ("stop" if eos is not None and eos in emitted
                           else "length")
-                chunk_event([], text=(final or {}).get("completion", ""),
-                            finish=finish)
+                # the final event completes the text: the handler computes
+                # the tail a delta-concatenating client still needs (it
+                # knows exactly what the chunks carried); fall back to
+                # completion-minus-sent for handlers without the field
+                final_rec = final or {}
+                if "text" in final_rec:
+                    tail = final_rec["text"]
+                else:
+                    completion = final_rec.get("completion", "")
+                    tail = (completion[len(text_sent):]
+                            if completion.startswith(text_sent)
+                            else completion)
+                chunk_event([], text=tail, finish=finish)
                 server_self.stats.record((time.monotonic() - t0) * 1e3)
                 if event(b"[DONE]"):
                     self._end_frames()

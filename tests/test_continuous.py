@@ -1,0 +1,198 @@
+"""Continuous (in-flight) batching: requests join a running decode at
+segment boundaries with bitwise solo parity (VERDICT r3 missing #3)."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def tiny_server():
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    return adapter.make_server(params)
+
+
+def test_staggered_concurrent_requests_match_solo(tiny_server):
+    """8 staggered concurrent requests produce exactly their solo outputs
+    while SHARING segment steps (the whole point: rows ride the same
+    device calls instead of queueing end-to-end)."""
+    cb = ContinuousBatcher(tiny_server, slots=8, segment=8)
+    prompts = [[1 + i, 2 + i, 3 + i, 5] for i in range(8)]
+    n = 16
+    solo = [tiny_server.generate(p, max_new_tokens=n) for p in prompts]
+
+    results = [None] * 8
+
+    def run(i):
+        time.sleep(0.02 * i)  # staggered arrivals, mid-flight joins
+        results[i] = cb.generate(prompts[i], max_new_tokens=n)
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        list(ex.map(run, range(8)))
+
+    for i in range(8):
+        np.testing.assert_array_equal(results[i], solo[i],
+                                      err_msg=f"request {i} diverged")
+    stats = cb.stats()
+    # solo would cost 8 requests x ceil(16/8) = 16 segment runs; sharing
+    # must beat that, and rows-per-segment > 1 proves actual fusion
+    assert stats["segments_run"] < 16, stats
+    assert stats["rows_in_segments"] > stats["segments_run"], stats
+    assert stats["requests_served"] == 8, stats
+
+
+def test_midflight_join(tiny_server):
+    """A request arriving while another is decoding joins at the next
+    segment boundary instead of waiting for the whole decode."""
+    cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
+    long_prompt, short_prompt = [1, 2, 3, 4, 5], [9, 8, 7]
+    n_long, n_short = 24, 8
+    solo_long = tiny_server.generate(long_prompt, max_new_tokens=n_long)
+    solo_short = tiny_server.generate(short_prompt, max_new_tokens=n_short)
+
+    out = {}
+
+    def late():
+        time.sleep(0.05)
+        out["short"] = cb.generate(short_prompt, max_new_tokens=n_short)
+
+    t = threading.Thread(target=late)
+    t.start()
+    out["long"] = cb.generate(long_prompt, max_new_tokens=n_long)
+    t.join()
+    np.testing.assert_array_equal(out["long"], solo_long)
+    np.testing.assert_array_equal(out["short"], solo_short)
+
+
+def test_mixed_eos_rows_share_the_batch(tiny_server):
+    """eos is host-side: rows with DIFFERENT eos ids fuse into one batch
+    and still match their solo outputs (including the eos filler tail)."""
+    cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
+    # find a token each row actually emits, to use as its eos
+    free = tiny_server.generate([5, 6, 7, 8], max_new_tokens=8)[0]
+    eos_a = int(free[2])
+    free_b = tiny_server.generate([1, 2], max_new_tokens=8)[0]
+    eos_b = int(free_b[3])
+    solo_a = tiny_server.generate([5, 6, 7, 8], max_new_tokens=8,
+                                  eos_id=eos_a)
+    solo_b = tiny_server.generate([1, 2], max_new_tokens=8, eos_id=eos_b)
+
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        fa = ex.submit(cb.generate, [5, 6, 7, 8], max_new_tokens=8,
+                       eos_id=eos_a)
+        fb = ex.submit(cb.generate, [1, 2], max_new_tokens=8, eos_id=eos_b)
+        np.testing.assert_array_equal(fa.result(), solo_a)
+        np.testing.assert_array_equal(fb.result(), solo_b)
+
+
+def test_logprobs_ride_continuous_batching(tiny_server):
+    cb = ContinuousBatcher(tiny_server, slots=2, segment=4)
+    toks, lps = cb.generate([1, 2, 3], max_new_tokens=8,
+                            return_logprobs=True)
+    st, sl = tiny_server.generate([1, 2, 3], max_new_tokens=8,
+                                  return_logprobs=True)
+    np.testing.assert_array_equal(toks, st)
+    np.testing.assert_allclose(lps, sl, rtol=1e-5, atol=1e-6)
+
+
+def test_sampled_requests_bypass_to_solo(tiny_server):
+    """temperature > 0 must run solo (seed reproducibility) — identical
+    to the server's own sampled output."""
+    cb = ContinuousBatcher(tiny_server, slots=2, segment=4)
+    got = cb.generate([1, 2, 3], max_new_tokens=6, temperature=0.9, seed=7)
+    ref = tiny_server.generate([1, 2, 3], max_new_tokens=6,
+                               temperature=0.9, seed=7)
+    np.testing.assert_array_equal(got, ref)
+    assert cb.stats()["segments_run"] == 0  # never touched the engine
+
+
+def test_overflow_rejected(tiny_server):
+    cb = ContinuousBatcher(tiny_server, slots=2, segment=4, cache_len=32)
+    with pytest.raises(ValueError, match="cache_len"):
+        cb.generate(list(range(1, 30)), max_new_tokens=16)
+
+
+def test_engine_failure_surfaces_to_callers(tiny_server, monkeypatch):
+    """An engine crash must fail pending requests, not hang them, and the
+    engine must restart cleanly afterwards."""
+    cb = ContinuousBatcher(tiny_server, slots=2, segment=4)
+
+    def boom(self):
+        raise RuntimeError("injected engine failure")
+
+    monkeypatch.setattr(ContinuousBatcher, "_segment_fn", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        cb.generate([1, 2, 3], max_new_tokens=8)
+    monkeypatch.undo()
+    out = cb.generate([1, 2, 3], max_new_tokens=8)
+    np.testing.assert_array_equal(
+        out, tiny_server.generate([1, 2, 3], max_new_tokens=8))
+
+
+def test_more_requests_than_slots(tiny_server):
+    """Joiners beyond the slot count wait for a free slot and still
+    complete correctly (slot turnover mid-engine-run)."""
+    cb = ContinuousBatcher(tiny_server, slots=2, segment=4)
+    prompts = [[1 + i, 3, 5] for i in range(5)]
+    solo = [tiny_server.generate(p, max_new_tokens=8) for p in prompts]
+    with ThreadPoolExecutor(max_workers=5) as ex:
+        futs = [ex.submit(cb.generate, p, max_new_tokens=8)
+                for p in prompts]
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(), solo[i],
+                                          err_msg=f"request {i}")
+
+
+@pytest.mark.slow
+def test_http_continuous_batching_end_to_end(tmp_path):
+    """batch_mode='continuous' through the real bundle + threaded HTTP
+    server: concurrent greedy invokes ride shared segment steps and
+    /metrics exposes the engine counters."""
+    import json
+    import urllib.request
+
+    from tests.test_runtime import make_model_bundle
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "8", "batch_mode": "continuous",
+               "batch_max": "4", "batch_segment": "4"})
+    server = BundleServer(bundle, port=0).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def post(payload):
+        req = urllib.request.Request(
+            f"{base}/invoke", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    try:
+        ref = post({"tokens": [1, 2, 3]})
+        assert ref["ok"], ref
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            futs = [ex.submit(post, {"tokens": [1, 2, 3 + i]})
+                    for i in range(4)]
+            results = [f.result() for f in futs]
+        assert all(r["ok"] and r["n_new"] == 8 for r in results)
+        # same prompt, concurrent or not -> same tokens
+        again = post({"tokens": [1, 2, 3]})
+        assert again["tokens"] == ref["tokens"]
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            metrics = json.loads(r.read())
+        engine = metrics["handler"]["batching"]
+        assert engine["mode"] == "continuous"
+        assert engine["requests_served"] >= 6
+        assert engine["rows_in_segments"] > engine["segments_run"], engine
+    finally:
+        server.stop()

@@ -115,13 +115,17 @@ def test_save_hf_params_and_registry_roundtrip(hf_llama, tmp_path):
 
 
 def _tiny_tokenizer(save_dir):
-    """A real (WordLevel) HF tokenizer built offline."""
+    """A real (WordLevel) HF tokenizer built offline. The vocab covers the
+    model's whole 128-id range: random-weight generation produces ids
+    anywhere in the model vocab, and the streaming-text assertions need
+    them to decode to something."""
     from tokenizers import Tokenizer, models, pre_tokenizers
     from transformers import PreTrainedTokenizerFast
 
     words = ["hello", "world", "the", "cat", "sat", "on", "mat", "a"]
     vocab = {"[UNK]": 0, "[EOS]": 1}
     vocab.update({w: i + 2 for i, w in enumerate(words)})
+    vocab.update({f"w{i}": i for i in range(len(vocab), 128)})
     tok = Tokenizer(models.WordLevel(vocab=vocab, unk_token="[UNK]"))
     tok.pre_tokenizer = pre_tokenizers.Whitespace()
     fast = PreTrainedTokenizerFast(tokenizer_object=tok, unk_token="[UNK]",
@@ -192,6 +196,34 @@ max_new_tokens = 4
         assert bad["ok"] is False and "zero tokens" in bad["error"]
         bad2 = rt.invoke("hf1", {"tokens": []})
         assert bad2["ok"] is False
+        # SSE /v1/completions with a STRING prompt streams INCREMENTAL
+        # text: chunks carry deltas whose concatenation (with the final
+        # tail event) equals the non-streamed completion exactly once
+        # (ADVICE r3: clients rendering choices[0].text incrementally saw
+        # nothing until the stream ended)
+        import json as _json
+        import urllib.request
+
+        # eos_id -1 disables eos latching: the random-weight model may
+        # emit [EOS] immediately, which would make the completion empty
+        # and the incremental-text assertion vacuous
+        ref = rt.invoke("hf1", {"text": "the cat sat", "max_new_tokens": 4,
+                                "eos_id": -1})
+        req = urllib.request.Request(
+            f"{rt.get('hf1').url}/v1/completions",
+            data=_json.dumps({"prompt": "the cat sat", "max_tokens": 4,
+                              "temperature": 0, "stream": True,
+                              "segment": 4, "eos_id": -1}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            events = [ln.decode().strip()[len("data: "):] for ln in resp
+                      if ln.strip().startswith(b"data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [_json.loads(e) for e in events[:-1]]
+        streamed = "".join(p["choices"][0]["text"] for p in parsed)
+        assert streamed == ref["completion"]
+        assert any(p["choices"][0]["text"] and p["choices"][0]["tokens"]
+                   for p in parsed), "no non-final chunk carried text"
     finally:
         rt.stop("hf1")
 

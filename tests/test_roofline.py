@@ -1,0 +1,90 @@
+"""Roofline/MFU accounting (utils/roofline.py): the cost models every
+published bench/baseline number is related to v5e peak through."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import pytest
+
+from lambdipy_tpu.models.llama import LLAMA3_8B, LLAMA_TINY
+from lambdipy_tpu.utils import roofline as R
+
+
+def test_llama_8b_matmul_param_count():
+    # Llama-3-8B has ~8.0B params incl. the 0.5B embedding; matmul
+    # (embed-excluded) is ~7.5B
+    n = R.llama_matmul_params(LLAMA3_8B)
+    assert 7.4e9 < n < 7.6e9
+
+
+def test_matmul_params_match_real_module():
+    """The analytic count must equal the actual QDense kernel sizes of an
+    initialized model (embed + norm scales are the only non-matmul
+    params)."""
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    import jax
+
+    total = sum(x.size for x in jax.tree.leaves(params))
+    cfg = LLAMA_TINY
+    embed = cfg.vocab_size * cfg.hidden
+    norms = cfg.layers * 2 * cfg.hidden + cfg.hidden
+    assert total == R.llama_matmul_params(cfg) + embed + norms
+
+
+def test_int8_weight_bytes_half_of_bf16():
+    bf16 = R.llama_weight_bytes(LLAMA3_8B)
+    int8 = R.llama_weight_bytes(dataclasses.replace(LLAMA3_8B, quant="int8"))
+    # int8 stores 1 byte/param vs 2 (scales are per-channel noise)
+    assert int8 * 2 == bf16
+
+
+def test_8b_decode_is_weight_bytes_bound():
+    """b1 decode of 8B int8 is HBM-bound: the roofline time equals the
+    weight-read time, ~9 ms -> ~108 tok/s upper bound (the number the
+    VERDICT's honest-accounting critique predicts)."""
+    cfg = dataclasses.replace(LLAMA3_8B, quant="int8")
+    c = R.llama_decode_step_cost(cfg, batch=1, cache_len=512)
+    t_weights_ms = R.llama_weight_bytes(cfg) / R.V5E_HBM_BYTES_S * 1e3
+    assert c.time_lower_bound_ms() == pytest.approx(t_weights_ms, rel=0.05)
+    bound = R.llama_decode_tok_s_bound(cfg, batch=1, cache_len=512)
+    assert 95 < bound < 115
+
+
+def test_batching_amortizes_weight_reads():
+    cfg = dataclasses.replace(LLAMA3_8B, quant="int8")
+    b1 = R.llama_decode_tok_s_bound(cfg, batch=1, cache_len=512)
+    b8 = R.llama_decode_tok_s_bound(cfg, batch=8, cache_len=512)
+    assert b8 > 6 * b1  # near-linear until KV reads start to matter
+
+
+def test_kv_quant_halves_cache_traffic():
+    cfg = LLAMA3_8B
+    q = dataclasses.replace(cfg, kv_quant="int8")
+    assert R.llama_kv_bytes_per_pos(q) * 2 == R.llama_kv_bytes_per_pos(cfg)
+
+
+def test_prefill_is_compute_bound_at_1k():
+    cfg = dataclasses.replace(LLAMA3_8B, quant="int8")
+    c = R.llama_prefill_cost(cfg, batch=1, seq_len=1024)
+    assert c.flops / R.V5E_BF16_FLOPS > c.hbm_bytes / R.V5E_HBM_BYTES_S
+
+
+def test_param_bytes_counts_storage():
+    params = {"a": jnp.zeros((4, 4), jnp.int8),
+              "b": jnp.zeros((2, 2), jnp.float32)}
+    assert R.param_bytes(params) == 16 + 16
+
+
+def test_utilization_fields():
+    c = R.Cost(flops=1e12, hbm_bytes=1e9)
+    u = c.utilization(measured_s=0.01)
+    # 1e12 FLOP in 10 ms on a 197 TFLOP/s part
+    assert u["mfu"] == pytest.approx(1e12 / (0.01 * R.V5E_BF16_FLOPS),
+                                     abs=1e-4)
+    assert 0 < u["hbm_util"] < 1
+    assert u["roofline_ms"] == pytest.approx(
+        max(1e12 / R.V5E_BF16_FLOPS, 1e9 / R.V5E_HBM_BYTES_S) * 1e3,
+        rel=1e-3)

@@ -469,8 +469,8 @@ def test_generate_handler_ragged_json_rows(llama_bundle):
 
 def test_generate_handler_prefix_caching(llama_bundle):
     """`prefix` requests reuse the cached prefix KV and match the
-    concatenated-prompt response; streamed prefix requests fall back to
-    concatenation with identical tokens."""
+    concatenated-prompt response; streamed prefix requests consume the
+    cached KV too (prefix_cached true) with identical tokens."""
     import numpy as np
 
     from lambdipy_tpu.runtime.loader import load_bundle
@@ -490,6 +490,9 @@ def test_generate_handler_prefix_caching(llama_bundle):
     streamed = [t for c in chunks if c.get("ok") and "tokens" in c
                 for t in c["tokens"][0]]
     assert streamed == full["tokens"][0]
+    summary = chunks[-1]
+    assert summary.get("done") and summary["prefix_cached"] is True, summary
+    assert summary["n_prompt"] == len(prefix) + len(suffix)
     bad = report.handler.invoke(report.state,
                                 {"prefix": [], "tokens": suffix})
     assert not bad["ok"]
