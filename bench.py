@@ -39,6 +39,9 @@ def _stage_timeout(stage: str, platform: str) -> float:
         # compiles a full (small) Llama serve program — a real model
         # compile, not a probe; remote-compile transports need headroom
         return float(os.environ.get("LAMBDIPY_BENCH_DECODE_TIMEOUT", "900"))
+    if stage == "decode8b":
+        # 8 GB weight upload + a 32-layer program compile
+        return float(os.environ.get("LAMBDIPY_BENCH_8B_TIMEOUT", "1500"))
     # probes only pay interpreter+PJRT init (~10 s) plus one small compile
     return float(os.environ.get("LAMBDIPY_BENCH_PROBE_TIMEOUT", "240"))
 
@@ -234,6 +237,38 @@ def _stage_decode() -> int:
     return 0
 
 
+def _stage_decode8b() -> int:
+    """REAL-dims secondary metric: Llama-3-8B int8 (4096x32x128256) batch-8
+    decode through LlamaServer, with HBM-utilization accounting. Runs only
+    when the random-init 8B flatpack is already cached (scripts/
+    measure_8b.py builds it once, ~6 min) or LAMBDIPY_BENCH_8B_GEN=1
+    forces generation; failure or absence never degrades the headline."""
+    import importlib.util
+
+    _maybe_wedge("decode8b")
+    spec = importlib.util.spec_from_file_location(
+        "measure_8b",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "scripts", "measure_8b.py"))
+    m8b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m8b)
+    if not m8b.params_path().is_file() and \
+            os.environ.get("LAMBDIPY_BENCH_8B_GEN") != "1":
+        print(json.dumps({"decode8b": "skipped: no cached 8B params "
+                          "(run scripts/measure_8b.py once)"}))
+        return 0
+    rec = m8b.measure(batches=(8,), n_new=64, do_prefill=False)
+    print(json.dumps({
+        "decode8b_tok_s": rec["b8_decode_tok_s"],
+        "decode8b_hbm_util": rec["b8_decode_hbm_util"],
+        "decode8b_roofline_tok_s": rec["b8_roofline_tok_s"],
+        "decode8b_dims": rec["dims"],
+        "decode8b_batch": 8,
+        "decode8b_weight_upload_s": rec["weight_upload_s"],
+    }))
+    return 0
+
+
 def _timed(fn) -> float:
     t0 = time.monotonic()
     fn()
@@ -263,7 +298,8 @@ def main() -> int:
     if "--stage" in sys.argv:
         stage = sys.argv[sys.argv.index("--stage") + 1]
         return {"devices": _stage_devices, "matmul": _stage_matmul,
-                "model": _stage_model, "decode": _stage_decode}[stage]()
+                "model": _stage_model, "decode": _stage_decode,
+                "decode8b": _stage_decode8b}[stage]()
 
     here = os.path.dirname(os.path.abspath(__file__))
     base_env = dict(os.environ)
@@ -299,10 +335,12 @@ def main() -> int:
             # (skipped on the cpu fallback: slow there and not the story);
             # its failure is recorded but never degrades the headline
             if platform != "cpu":
-                data, err = _run_stage("decode", env, platform)
-                stages_log[f"{label}.decode"] = "ok" if err is None else err
-                if data is not None:
-                    result.update(data)
+                for extra_stage in ("decode", "decode8b"):
+                    data, err = _run_stage(extra_stage, env, platform)
+                    stages_log[f"{label}.{extra_stage}"] = (
+                        "ok" if err is None else err)
+                    if data is not None:
+                        result.update(data)
             result["stages"] = stages_log
             print(json.dumps(result))
             return 0

@@ -148,6 +148,132 @@ def save_checkpoint_files(params_dir: Path, params,
     return "+".join(fmt)
 
 
+def _read_header(path: Path):
+    """(header dict, mmap over the whole file)."""
+    with open(path, "rb") as f:
+        head = f.read(len(MAGIC) + 8)
+        if head[: len(MAGIC)] != MAGIC:
+            raise ValueError(f"{path}: not a flatpack file")
+        (header_len,) = struct.unpack("<Q", head[len(MAGIC):])
+        header = json.loads(f.read(header_len))
+        buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    return header, buf
+
+
+# compiled unpack programs keyed by the group's relative layout — groups
+# with identical structure (e.g. every transformer layer) share one
+# compiled program
+_unpack_cache: dict = {}
+
+
+_STAGE_DTYPE = {1: np.uint8, 2: np.uint16, 4: np.uint32}
+
+
+def device_load(path: Path, *, chunk_bytes: int = 512 << 20,
+                small_leaf_bytes: int = 1 << 20):
+    """Load a flatpack straight onto the (single) device with FEW LARGE
+    transfers: leaves are packed into per-itemsize staging buffers that
+    upload as one array each, then a jitted device-side unpack slices and
+    SAME-WIDTH bitcasts every tensor out.
+
+    Why: ``jax.device_put`` of a big pytree pays a per-leaf transfer
+    overhead that dominates boot at scale — measured through this image's
+    remote PJRT tunnel: ~88 ms/leaf fixed cost and ~50 MB/s asymptotic
+    bandwidth, so the 8B int8 tree (~420 leaves) spent ~37 s of its 252 s
+    upload on per-leaf overhead alone. On locally attached hardware the
+    same strategy turns hundreds of small PCIe DMAs into dozens of large
+    ones.
+
+    Two load-bearing shape rules:
+    - staging buffers are 1-D arrays of the UNSIGNED dtype with the
+      leaf's own itemsize, and the unpack only ever bitcasts same-width
+      (u16->bf16, u32->f32, u8->i8). A mixed-width bitcast needs an
+      [n, itemsize] uint8 intermediate whose minor dim the TPU tiles to
+      128 — measured: a 1 GB bf16 embedding exploded into a 134 GB
+      allocation request.
+    - big leaves (> ``small_leaf_bytes``) chunk at ``chunk_bytes`` within
+      their top-level subtree, so identical transformer layers share one
+      compiled unpack program and peak extra HBM stays ~one chunk; ALL
+      small leaves (scales, norms) of one width ride a single global
+      buffer — one transfer instead of hundreds.
+
+    Single-device only (callers with a mesh use the host-tree path and
+    let the sharder place leaves)."""
+    import jax
+    import jax.numpy as jnp
+
+    header, buf = _read_header(Path(path))
+    entries = header["entries"]
+
+    # 64-bit leaves cannot ride this path: under the default
+    # jax_enable_x64=False, device_put canonicalizes a uint64 staging
+    # buffer to uint32 and the bitcast would silently corrupt values.
+    # Fall back to the host-tree load — the caller's device_put applies
+    # jax's documented canonicalization to the VALUES (not raw bits),
+    # which is the behavior such a model had before this fast path.
+    if any(_np_dtype(e["dtype"]).itemsize > 4 for e in entries):
+        return load(path)
+
+    # partition into chunks: (stage_itemsize, [entry...]) — big leaves
+    # grouped by (subtree, itemsize) capped at chunk_bytes; small leaves
+    # into one global per-itemsize bucket
+    chunks: list[tuple[int, list[dict]]] = []
+    small: dict[int, list[dict]] = {}
+    cur_key, cur = None, None
+    for e in entries:
+        isize = _np_dtype(e["dtype"]).itemsize
+        if e["nbytes"] <= small_leaf_bytes:
+            small.setdefault(isize, []).append(e)
+            continue
+        key = (tuple(e["path"][:2]), isize)
+        if key != cur_key or sum(x["nbytes"] for x in cur) + e["nbytes"] \
+                > chunk_bytes:
+            cur = []
+            chunks.append((isize, cur))
+            cur_key = key
+        cur.append(e)
+    for isize, es in sorted(small.items()):
+        chunks.append((isize, es))
+
+    out = []
+    for isize, group in chunks:
+        stage_dt = _STAGE_DTYPE[isize]
+        parts = [np.frombuffer(buf, stage_dt, count=e["nbytes"] // isize,
+                               offset=e["offset"]) for e in group]
+        staged = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        rel, sig = 0, []
+        for e in group:
+            sig.append((rel, e["dtype"], tuple(e["shape"])))
+            rel += e["nbytes"] // isize
+        sig = (isize, tuple(sig))
+        fn = _unpack_cache.get(sig)
+        if fn is None:
+            def build(sig):
+                _, leaf_sig = sig
+
+                def unpack(raw):
+                    leaves = []
+                    for off, dtype_name, shape in leaf_sig:
+                        dt = jnp.dtype(_np_dtype(dtype_name))
+                        n = 1
+                        for d in shape:
+                            n *= d
+                        b = jax.lax.slice(raw, (off,), (off + n,))
+                        leaves.append(
+                            jax.lax.bitcast_convert_type(b, dt).reshape(shape))
+                    return leaves
+
+                return jax.jit(unpack)
+
+            fn = _unpack_cache[sig] = build(sig)
+        staged_dev = jax.device_put(staged)
+        leaves = fn(staged_dev)
+        del staged_dev  # free the staging buffer before the next chunk
+        for e, leaf in zip(group, leaves):
+            out.append((tuple(e["path"]), leaf))
+    return _unflatten(out)
+
+
 def load(path: Path):
     """Memory-map ``path`` and return the nested-dict tree of numpy views."""
     path = Path(path)

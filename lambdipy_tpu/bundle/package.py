@@ -107,6 +107,39 @@ def materialize_payload(recipe: Recipe, bundle_dir: Path) -> dict:
                               params_format=payload.params_format)
         manifest_payload["params"] = "params"
         manifest_payload["params_info"] = info
+    elif payload.params not in ("init", "none", ""):
+        # the schema's third form: a checkpoint PATH — either a params
+        # dir written by save_checkpoint_files (orbax/ and/or params.fpk)
+        # or a bare .fpk file. Every file is hardlinked when source and
+        # bundle share a filesystem (an 8B fpk is ~8 GB; bundles never
+        # mutate params), copied otherwise.
+        import os
+        import shutil
+
+        def link_or_copy(s, d):
+            try:
+                os.link(s, d)
+            except OSError:
+                shutil.copy2(s, d)
+
+        src = Path(payload.params)
+        params_dir = Path(bundle_dir) / "params"
+        if src.is_file() and src.suffix == ".fpk":
+            params_dir.mkdir(parents=True, exist_ok=True)
+            link_or_copy(src, params_dir / "params.fpk")
+        elif src.is_dir() and ((src / "params.fpk").is_file()
+                               or (src / "orbax").is_dir()):
+            # validated up front: a typo'd-but-existing directory must
+            # fail the BUILD, not the eventual serve boot
+            shutil.copytree(src, params_dir, copy_function=link_or_copy)
+        else:
+            raise ValueError(
+                f"recipe {recipe.name}: payload.params {payload.params!r} "
+                "is neither 'init'/'hf', a params dir (params.fpk or "
+                "orbax/ inside), nor a .fpk file")
+        manifest_payload["params"] = "params"
+        manifest_payload["params_info"] = {"format": "external",
+                                           "source": str(src)}
     return manifest_payload
 
 

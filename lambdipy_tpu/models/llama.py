@@ -55,6 +55,9 @@ class LlamaConfig:
     # Prefill attention backend: "dense" (XLA-fused, default), "flash"
     # (Pallas kernel when shapes tile), or "ring" (sequence-parallel ring
     # attention over the ambient mesh's sp axis — the long-context path).
+    # Defaults measured, not assumed: docs/kernels.md — XLA dense wins at
+    # <=4k context on v5e; flash is the O(S)-memory fallback for contexts
+    # whose dense score tensor would not fit.
     attn_backend: str = "dense"
     # Sparse MoE FFN (Mixtral-style): >0 replaces the dense SwiGLU with
     # moe_experts top-k routed experts (models/moe.py), expert dim sharded
@@ -65,7 +68,11 @@ class LlamaConfig:
     moe_group_size: int = 256  # routing-group size (models/moe.py)
     # int8 matmul backend: "xla" (dequant fused by XLA, works under TP
     # sharding) or "pallas" (ops/quant.py blocked kernel — single-chip
-    # serving; falls back per-matmul when shapes don't tile).
+    # serving; falls back per-matmul when shapes don't tile). Measured
+    # head-to-head at 8B shapes (docs/kernels.md): XLA's fused dequant
+    # runs at 390-710 GB/s effective weight bandwidth vs the kernel's
+    # ~65, and the full 8B decode sits at 82% of the int8 roofline — the
+    # default follows the data.
     matmul_backend: str = "xla"
 
     @property
@@ -724,11 +731,22 @@ class LlamaServer:
 
     def __init__(self, model: LlamaModel, params, *, mesh=None,
                  min_bucket: int = 16, decode_cap: int | None = None,
-                 prefix_cache_max: int = 4, program_cache_max: int = 64):
+                 prefix_cache_max: int = 4, program_cache_max: int = 64,
+                 aot=None):
         self.model = model
         self.params = params
         self.mesh = mesh
         self.min_bucket = min_bucket
+        # optional runtime/aot.AotStore: serving programs are loaded from
+        # the bundle's serialized-executable tier instead of compiled
+        # (the 8B boot pays ~70 s of remote compile PER program without
+        # this), and aot_save_all() snapshots freshly compiled programs
+        # after warmup so the next boot hits. Example operands for
+        # probe/export are SYNTHESIZED from each program key — shapes are
+        # fully determined by (bucket, cache_len, config).
+        self._aot = aot
+        self._aot_loaded: set = set()
+        self.aot_hits = 0  # programs served from the AOT store this boot
         # default: anything the context window allows is servable (power-
         # of-two bucketing bounds distinct compiles at log2(max_len))
         self.decode_cap = decode_cap or model.cfg.max_len
@@ -776,7 +794,9 @@ class LlamaServer:
     def compile_count(self) -> int:
         with self._fns_lock:
             fns = list(self._fns.values())
-        return sum(f._cache_size()
+        # AOT-loaded executables are not jit objects; count each as one
+        # compiled program
+        return sum(getattr(f, "_cache_size", lambda: 1)()
                    for fn in fns
                    for f in (fn if isinstance(fn, tuple) else (fn,)))
 
@@ -792,17 +812,170 @@ class LlamaServer:
         with ``jax.jit`` (lazy — tracing/compiling happens at first call),
         so holding the lock through it is cheap; what the lock buys is
         that at most one wrapper per key ever exists, so concurrent racers
-        share one compiled program instead of each tracing their own."""
+        share one compiled program instead of each tracing their own.
+        With an AOT store attached, a miss first tries the bundle's
+        serialized executables (outside the lock — a probe invokes the
+        program) before falling back to the jit wrapper."""
         with self._fns_lock:
             fn = self._fns.get(key)
-            if fn is None:
-                fn = self._fns[key] = build()
-            else:
+            if fn is not None:
                 self._fns.move_to_end(key)
+                return fn
+        loaded = self._aot_load(key) if self._aot is not None else None
+        with self._fns_lock:
+            fn = self._fns.get(key)  # a racer may have won meanwhile
+            if fn is None:
+                fn = self._fns[key] = (loaded if loaded is not None
+                                       else build())
+                if loaded is not None:
+                    self._aot_loaded.add(key)
+                    self.aot_hits += 1
             while len(self._fns) > self._fns_max:
                 self._fns.popitem(last=False)
                 self._fn_evictions += 1
             return fn
+
+    # -- AOT snapshot/restore of compiled serving programs -------------------
+
+    @staticmethod
+    def _aot_name(key: tuple) -> str | None:
+        """Artifact name(s) for a program-cache key; None = not AOT-able."""
+        if isinstance(key[0], int):  # fused decode (b, sb, steps)
+            return "srv-dec-" + "-".join(map(str, key))
+        kind = key[0]
+        if kind in ("stream", "prefix", "continue", "stream_prefix"):
+            return f"srv-{kind}-" + "-".join(map(str, key[1:]))
+        return None
+
+    def _aot_examples(self, key: tuple):
+        """Synthesized example operand tuples (excluding params) matching
+        the traced shapes of the key's program(s). Returns a list — one
+        per callable the key maps to (streaming keys map to a pair)."""
+        cfg = self.model.cfg
+        knobs = self._knob_operands(0.0, None, None, 0, None)
+
+        def prompt_ops(b, sb):
+            return (jnp.zeros((b, sb), jnp.int32),
+                    jnp.ones((b,), jnp.int32))
+
+        def prefix_cache(cache_len):
+            cache = init_decode_cache(cfg, 1, cache_len)
+            for entry in cache:
+                entry["index"] = jnp.int32(1)  # prefix cache: scalar index
+            return cache
+
+        if isinstance(key[0], int):
+            b, sb, _steps = key
+            return [(*prompt_ops(b, sb), *knobs)]
+        kind = key[0]
+        if kind == "stream":
+            _, b, sb, cache_len, _segment = key
+            t, k, p, rng, eos = knobs
+            index = jnp.ones((b,), jnp.int32)  # per-row, like the prefill
+            cache = init_decode_cache(cfg, b, cache_len)
+            for entry in cache:
+                entry["index"] = index
+            seg_ex = (t, k, p,
+                      jnp.zeros((b,), jnp.int32),    # first token
+                      jnp.zeros((b,), jnp.float32),  # lp
+                      cache, index,                  # pos
+                      jnp.zeros((b,), jnp.bool_),    # done
+                      rng, eos)
+            return [(*prompt_ops(b, sb), *knobs), seg_ex]
+        if kind == "prefix":
+            _, sb, _cache_len = key
+            return [(jnp.zeros((1, sb), jnp.int32), jnp.int32(1))]
+        if kind == "continue":
+            _, sbs, _steps, cache_len = key
+            return [(prefix_cache(cache_len),
+                     jnp.zeros((1, sbs), jnp.int32), jnp.int32(1), *knobs)]
+        if kind == "stream_prefix":
+            _, sbs = key
+            return [(prefix_cache(cfg.max_len),
+                     jnp.zeros((1, sbs), jnp.int32), jnp.int32(1), *knobs)]
+        return None
+
+    def _aot_load(self, key: tuple):
+        """Best-effort load of the key's program(s) from the AOT store;
+        returns the callable (or pair) only when EVERY part hits."""
+        name = self._aot_name(key)
+        if name is None:
+            return None
+        # existence first (a stat per part): synthesizing probe operands
+        # allocates full KV caches on device — wasted work for every
+        # never-saved key (first boots, fresh prefix buckets)
+        names = [name] if not isinstance(key[0], str) or \
+            key[0] != "stream" else [f"{name}-p0", f"{name}-p1"]
+        if not all(self._aot.has(n) for n in names):
+            return None
+        try:
+            examples = self._aot_examples(key)
+        except Exception:
+            return None
+        if len(examples) != len(names):
+            return None
+        parts = []
+        for part_name, ex in zip(names, examples):
+            with self._mesh_ctx():
+                hit = self._aot.load(part_name, (self.params, *ex))
+            if hit is None:
+                return None
+            parts.append(hit[0])
+        return parts[0] if len(parts) == 1 else tuple(parts)
+
+    def aot_save_all(self) -> int:
+        """Snapshot every compiled serving program that was NOT itself
+        loaded from the store into the bundle's AOT exec tier (called
+        after warmup — build-time by the warm runner, serve-time after a
+        fresh compile — so the next boot loads executables instead of
+        compiling). Returns the number of artifacts written."""
+        if self._aot is None:
+            return 0
+        with self._fns_lock:
+            items = [(k, v) for k, v in self._fns.items()
+                     if k not in self._aot_loaded]
+        n = 0
+        for key, fn in items:
+            name = self._aot_name(key)
+            if name is None:
+                continue
+            try:
+                examples = self._aot_examples(key)
+            except Exception:
+                continue
+            fns = fn if isinstance(fn, tuple) else (fn,)
+            if len(fns) != len(examples):
+                continue
+            # only snapshot programs that actually COMPILED: a jit
+            # wrapper that never ran (e.g. the prefill half of a pair
+            # the continuous engine keyed but only uses the seg half of)
+            # would pay a fresh multi-second compile inside
+            # save_from_jitted's lower().compile() instead of the
+            # in-session cache hit the executed ones get
+            if any(getattr(part, "_cache_size", lambda: 0)() == 0
+                   for part in fns):
+                continue
+            wrote = 0
+            for i, (part, ex) in enumerate(zip(fns, examples)):
+                part_name = (name if len(examples) == 1
+                             else f"{name}-p{i}")
+                try:
+                    # both tiers: exec loads in seconds where it works
+                    # (single-device; the remote-tunnel cold-start path),
+                    # hlo + the warmed persistent cache covers platforms
+                    # where exec cannot load (e.g. multi-device CPU)
+                    meta = self._aot.save_from_jitted(
+                        part_name, part, (self.params, *ex))
+                    wrote += len(meta.get("tiers", ()))
+                except Exception:  # noqa: BLE001 — AOT is best-effort
+                    continue
+            if wrote:
+                n += wrote
+                with self._fns_lock:
+                    # saved once; a later call (e.g. after the background
+                    # bucket warm) must not re-export it
+                    self._aot_loaded.add(key)
+        return n
 
     def _compiled(self, b: int, sb: int, steps: int):
         cache_len = min(sb + steps, self.model.cfg.max_len)
@@ -1171,6 +1344,11 @@ class LlamaServer:
         cfg = self.model.cfg
         rows, lengths = self._normalize_prompts(prompt_tokens)
         b, s = len(rows), max(lengths)
+        if max_new_tokens == 0:
+            # nothing to emit: skip the device work (the prefix path's
+            # continue-prefill would otherwise compile + run for nothing)
+            self._validate(s, max_new_tokens)
+            return
         if prefix is not None:
             segment = max(1, min(int(segment), max(1, max_new_tokens)))
             yield from self._generate_stream_with_prefix(
@@ -1179,8 +1357,6 @@ class LlamaServer:
             return
         self._validate(s, max_new_tokens)
         segment = max(1, min(int(segment), max(1, max_new_tokens)))
-        if max_new_tokens == 0:
-            return
         # same bucketing discipline as generate(): pow-2 prompt bucket
         # (shrinking toward the exact prompt near max_len), batch
         # bucketed, and the SEGMENT COUNT pow-2 bucketed too — cache_len

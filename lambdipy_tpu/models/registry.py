@@ -570,8 +570,14 @@ def save_init_params(model: str, params_dir: Path, *, dtype: str = "bfloat16",
     return info
 
 
-def load_params(model: str, params_dir: Path):
-    """Load params previously saved by save_init_params."""
+def load_params(model: str, params_dir: Path, *, device: bool = False):
+    """Load params previously saved by save_init_params.
+
+    ``device=True`` (jax + flatpack only): load straight onto the single
+    device via grouped bulk transfers (flatpack.device_load) — at 8B
+    scale this removes the per-leaf transfer overhead that dominates the
+    boot upload. Meshed payloads keep the host tree (the sharder places
+    it)."""
     spec = get(model)
     params_dir = Path(params_dir)
     if spec.kind == "jax":
@@ -579,6 +585,8 @@ def load_params(model: str, params_dir: Path):
         if fpk.is_file():
             from lambdipy_tpu.bundle import flatpack
 
+            if device:
+                return flatpack.device_load(fpk)
             return flatpack.load(fpk)
         import orbax.checkpoint as ocp
 

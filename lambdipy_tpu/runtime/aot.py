@@ -74,9 +74,16 @@ class AotStore:
     multi-device program warmed on one topology is never replayed on
     another (VERDICT r2 missing #4: meshed payloads re-traced every boot)."""
 
-    def __init__(self, bundle_dir: Path, mesh=None):
+    def __init__(self, bundle_dir: Path, mesh=None,
+                 gate_ms: float | None = None):
         self.dir = Path(bundle_dir) / "aot"
         self.mesh = mesh
+        # per-store latency gate: the default suits sub-ms forward
+        # programs; callers AOT-ing programs whose honest steady-state
+        # call is long (a 64-token 8B decode runs ~700 ms) pass a gate
+        # sized to that work, keeping the gate's actual target — a tier
+        # that re-crosses the transport every call — detectable
+        self.gate_ms = _MAX_CALL_MS if gate_ms is None else float(gate_ms)
         self.rejected_slow = False  # set when a tier loaded but failed the gate
         # set when a matching meta existed but produced no usable tier —
         # the signal that re-saving would just reproduce the same artifacts
@@ -164,6 +171,69 @@ class AotStore:
             atomic_write_text(paths["meta"], json.dumps(meta, indent=1))
         return meta, jitted
 
+    def save_from_jitted(self, name: str, jitted: Callable,
+                         example_args: Sequence[Any],
+                         exec_only: bool = False) -> dict:
+        """Export an ALREADY-warmED ``jax.jit`` object's program (the
+        caller has invoked it at ``example_args``' shapes, so its compile
+        is done and cached in-session). Used by the serving path to
+        snapshot its compiled programs after warmup without paying the
+        extra trace+compile that :meth:`save`'s fresh ``jax.jit`` would.
+
+        ``exec_only`` skips the hlo tier (and its round-trip cache warm)
+        when the caller knows only the executable tier can win.
+        """
+        import jax
+
+        self.dir.mkdir(parents=True, exist_ok=True)
+        paths = self._paths(name)
+        meta = _env_key(self.mesh)
+        meta["tiers"] = []
+        with self._mesh_ctx():
+            if self.mesh is None:
+                try:
+                    from jax.experimental import serialize_executable
+
+                    # in-session this re-lower/compile is a compilation-
+                    # cache hit, not a fresh compile — the caller already
+                    # ran the program at these shapes
+                    compiled = jitted.lower(*example_args).compile()
+                    payload = serialize_executable.serialize(compiled)
+                    atomic_write_bytes(paths["exec"], pickle.dumps(payload))
+                    # self-test NOW (a deserialize + one call, seconds):
+                    # on some platforms (observed: multi-device CPU) a
+                    # serialized single-device executable cannot load
+                    # back; shipping it would make every boot pay the
+                    # failed attempt, and the skipped hlo warm below
+                    # would leave the real fallback cold
+                    fn = self._load_tier("exec", paths)
+                    jax.device_get(fn(*example_args))
+                    meta["tiers"].append("exec")
+                except Exception as e:
+                    paths["exec"].unlink(missing_ok=True)
+                    log.info("aot %s: executable tier unavailable: %s",
+                             name, e)
+            if not exec_only:
+                try:
+                    exported = jax.export.export(jitted)(*example_args)
+                    atomic_write_bytes(paths["hlo"],
+                                       bytes(exported.serialize()))
+                    # exec is probed first at load, so "hlo" goes last
+                    meta["tiers"].append("hlo")
+                    if "exec" not in meta["tiers"]:
+                        # platforms that will actually BOOT from the hlo
+                        # tier need its round-tripped module warmed into
+                        # the persistent cache (same reasoning as
+                        # save()); exec-capable platforms never probe it,
+                        # so skip the extra compile there
+                        jax.block_until_ready(
+                            jax.jit(exported.call)(*example_args))
+                except Exception as e:
+                    log.warning("aot %s: jax.export failed: %s", name, e)
+        if meta["tiers"]:
+            atomic_write_text(paths["meta"], json.dumps(meta, indent=1))
+        return meta
+
     def prune_slow_tiers(self, name: str, example_args: Sequence[Any]) -> list[str]:
         """Build-time self-test: load each just-saved tier on THIS platform
         and delete any that fail the latency gate, so the serve boot never
@@ -194,10 +264,10 @@ class AotStore:
                     t0 = time.monotonic()
                     jax.device_get(fn(*example_args))
                     ms = (time.monotonic() - t0) * 1000.0
-                if ms > _MAX_CALL_MS:
+                if ms > self.gate_ms:
                     log.warning(
                         "aot %s: pruning %s tier (steady %.0fms, first %.0fms, "
-                        "gate %.0fms)", name, tier, ms, first_ms, _MAX_CALL_MS)
+                        "gate %.0fms)", name, tier, ms, first_ms, self.gate_ms)
                     meta["tiers"].remove(tier)
                     paths[tier].unlink(missing_ok=True)
                     pruned.append(tier)
@@ -213,6 +283,11 @@ class AotStore:
             # boot from re-exporting/re-probing the same losing artifacts
             atomic_write_text(paths["meta"], json.dumps(meta, indent=1))
         return pruned
+
+    def has(self, name: str) -> bool:
+        """Cheap existence check (one stat) so callers can skip building
+        probe operands for artifacts that were never saved."""
+        return self._paths(name)["meta"].is_file()
 
     def _load_tier(self, tier: str, paths: dict):
         """Deserialize one tier into a callable (no probing/gating)."""
@@ -282,15 +357,15 @@ class AotStore:
             t0 = time.monotonic()
             jax.device_get(fn(*example_args))
             ms = (time.monotonic() - t0) * 1000.0
-            if ms > _MAX_CALL_MS:
+            if ms > self.gate_ms:
                 self.rejected_slow = True
                 log.warning(
                     "aot %s: %s tier steady call %.0fms (first %.0fms) "
                     "exceeds gate %.0fms; rejecting (plain jit + warm "
                     "cache will serve)", name, tier, ms, first_ms,
-                    _MAX_CALL_MS)
+                    self.gate_ms)
                 return False
-            if first_ms > _MAX_CALL_MS:
+            if first_ms > self.gate_ms:
                 log.info("aot %s: %s tier first call %.0fms (one-time "
                          "program load), steady %.0fms", name, tier,
                          first_ms, ms)

@@ -120,7 +120,11 @@ def _jax_adapter_and_params(spec: dict, ctx):
         dtype=spec.get("dtype", "bfloat16"), quant=spec.get("quant"),
         extra=extra)
     if ctx.params_dir is not None:
-        params = registry.load_params(spec["model"], ctx.params_dir)
+        # single-device payloads take the bulk-transfer device load; a
+        # mesh payload loads host-side so the sharder can place it
+        single = not any(v > 1 for v in (spec.get("mesh") or {}).values())
+        params = registry.load_params(spec["model"], ctx.params_dir,
+                                      device=single)
     else:
         params = adapter.init_params(seed=0)
     return adapter, params
@@ -294,6 +298,18 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # bucket diversity (rising program_evictions in /metrics
             # means it is too small)
             server_caps["program_cache_max"] = int(extra["program_cache_max"])
+        if mesh is None and getattr(ctx, "bundle_dir", None) is not None \
+                and str(extra.get("serve_aot", "1")) != "0":
+            # serving programs ride the bundle's AOT exec tier: at real
+            # scale each is a ~70 s remote compile, and a loaded
+            # executable boots in seconds. Gate sized for decode programs
+            # (an honest 8B 64-token decode call is ~700 ms — the default
+            # 500 ms forward-program gate would reject it as "slow").
+            from lambdipy_tpu.runtime.aot import AotStore
+
+            server_caps["aot"] = AotStore(
+                ctx.bundle_dir,
+                gate_ms=float(extra.get("serve_aot_gate_ms", 30000)))
         server = adapter.make_server(params, mesh=mesh, **server_caps)
         window_ms = float(extra.get("batch_window_ms", 0) or 0)
         batch_mode = str(extra.get("batch_mode", "") or "").lower()
@@ -359,6 +375,12 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                     # and one bad bucket must not abandon the rest
                     with _warm_lock:
                         warm_state["errors"].append(f"bucket {size}: {e}")
+            # the big buckets this thread just compiled should boot from
+            # the AOT tier next time too
+            try:
+                server.aot_save_all()
+            except Exception:  # noqa: BLE001 — AOT is best-effort
+                pass
 
         threading.Thread(target=_warm_buckets, daemon=True,
                          name="bucket-warm").start()
@@ -503,6 +525,16 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         try:
             return _invoke_parsed(parsed)
         finally:
+            if req.get("warmup") and server is not None:
+                # the warmup invoke itself compiled the fused decode
+                # program — snapshot everything compiled so far into the
+                # bundle's AOT exec tier so the NEXT boot loads
+                # executables instead of recompiling (no-op for programs
+                # that were themselves AOT-loaded)
+                try:
+                    server.aot_save_all()
+                except Exception:  # noqa: BLE001 — AOT is best-effort
+                    pass
             # first completed invoke (the boot warmup) releases the
             # background bucket warm
             _maybe_start_bucket_warm()
@@ -640,7 +672,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             return {}
         out = {"decode_buckets": [list(b) for b in server.buckets],
                "compile_count": server.compile_count,
-               "program_evictions": server.program_evictions}
+               "program_evictions": server.program_evictions,
+               "aot_hits": getattr(server, "aot_hits", 0)}
         if batcher is not None:
             out["batching"] = batcher.stats()
         if warm_state["requested"]:
@@ -710,5 +743,12 @@ def torch_text_classify_handler(spec: dict, ctx) -> HandlerState:
             "device": device_kind,  # "cpu" = the documented degraded path
         }
 
-    return HandlerState(invoke_fn=invoke,
-                        meta={"model": spec["model"], "device": device_kind})
+    meta = {"model": spec["model"], "device": device_kind}
+    if device_kind == "cpu":
+        # say it LOUDLY in /healthz meta, not just per-invoke: any number
+        # measured against this deployment is the documented CPU-torch
+        # degradation (torch-xla unavailable), not a TPU number
+        meta["degraded"] = ("torch-xla unavailable: serving on CPU torch; "
+                            "measured latencies are NOT TPU numbers "
+                            "(SURVEY.md §9.7)")
+    return HandlerState(invoke_fn=invoke, meta=meta)

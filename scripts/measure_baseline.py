@@ -164,6 +164,10 @@ def measure_config(num: int, *, invokes: int = 30,
             "recipe": cfg["recipe"],
             "platform": health.get("handler_meta", {}).get("platform",
                                                            cfg["platform"]),
+            # e.g. config4_torch: the handler flags its degraded CPU path
+            # so the published number can never read as a TPU number
+            **({"degraded": health["handler_meta"]["degraded"]}
+               if health.get("handler_meta", {}).get("degraded") else {}),
             "invoke_p50_ms": round(statistics.median(times), 3),
             "invoke_p99_ms": round(times[min(len(times) - 1,
                                              int(len(times) * 0.99))], 3),

@@ -160,3 +160,36 @@ def test_meshed_aot_rejects_other_mesh_shape(tmp_path, cpu_devices):
     tp4 = make_mesh({"tp": 4}, devices=cpu_devices[:4])
     store = AotStore(tmp_path, mesh=tp4)
     assert store.load("forward") is None
+
+
+@pytest.mark.slow  # two boots + dual-tier exports on one core
+def test_serving_programs_ride_aot_store(tmp_path):
+    """The LlamaServer decode/stream programs snapshot into the bundle's
+    AOT exec tier at warmup and a SECOND boot loads them instead of
+    compiling (the 8B cold start's dominant cost: ~70 s remote compile
+    per program)."""
+    from tests.test_runtime import make_model_bundle
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "4", "serve_aot": "1"})
+    # assembly does not warm; the FIRST boot compiles + saves.
+    r1 = load_bundle(bundle, warmup=True)
+    assert r1.warmup_result["ok"]
+    srv_artifacts = sorted(p.name for p in (bundle / "aot").glob("srv-*"))
+    # the exec tier self-tests at save time and is pruned on platforms
+    # where a single-device executable cannot load back (this 8-virtual-
+    # device CPU env); the hlo tier must always land
+    assert any(n.endswith(".hlo") for n in srv_artifacts), srv_artifacts
+    s1 = r1.state.stats()
+    assert s1["aot_hits"] == 0, s1
+
+    r2 = load_bundle(bundle, warmup=True)
+    s2 = r2.state.stats()
+    # fused decode + stream pair (+ any batcher programs) all hit
+    assert s2["aot_hits"] >= 2, s2
+    out = r2.handler.invoke(r2.state, {"tokens": [1, 2, 3]})
+    ref = r1.handler.invoke(r1.state, {"tokens": [1, 2, 3]})
+    assert out["ok"] and out["tokens"] == ref["tokens"]

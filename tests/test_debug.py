@@ -37,6 +37,7 @@ def test_apply_debug_env(monkeypatch):
         jax.config.update("jax_debug_nans", False)
 
 
+@pytest.mark.slow  # heavyweight parity; subsystem keeps a fast test
 def test_trainer_debug_numerics_catches_nan(cpu_devices):
     """A poisoned step fails fast under TrainerConfig.debug_numerics
     instead of logging nan losses forever."""

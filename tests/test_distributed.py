@@ -77,6 +77,7 @@ def test_process_batch_slice():
         process_batch_slice(33, process_index=0, process_count=2)
 
 
+@pytest.mark.slow  # heavyweight parity; subsystem keeps a fast test
 def test_train_checkpoint_resume(tmp_path, cpu_devices):
     """Save at steps 1..3, restore latest into a fresh run, training
     continues with identical state (SURVEY.md §6 checkpoint/resume row)."""

@@ -178,6 +178,7 @@ def test_flatpack_roundtrip_dtypes(tmp_path):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(leaf))
 
 
+@pytest.mark.slow  # heavyweight parity; subsystem keeps a fast test
 def test_int8_kv_cache_decode_close_to_float(tmp_path):
     """kv_quant='int8' halves decode-cache HBM; its decode-step logits
     must stay within quantization tolerance of the float cache, and the
@@ -300,3 +301,74 @@ def test_llama_builder_rejects_unknown_backend():
         registry.get("llama3-8b").build(extra={"attn_backend": "Flash"})
     with _pytest.raises(ValueError, match="matmul_backend"):
         registry.get("llama-hf").build(extra={"matmul_backend": "cuda"})
+
+
+def test_flatpack_device_load_matches_host_load(tmp_path):
+    """device_load (grouped single-buffer uploads + device-side unpack)
+    returns bitwise the same tree as the host mmap load: identical-layout
+    groups (transformer layers) share one compiled unpack program."""
+    import ml_dtypes
+
+    from lambdipy_tpu.bundle import flatpack
+
+    rng = np.random.default_rng(0)
+    tree = {"params": {
+        "embed": {"embedding": rng.standard_normal((50, 8), np.float32)
+                  .astype(ml_dtypes.bfloat16)},
+        "final_norm": {"scale": rng.standard_normal((8,)).astype(np.float32)},
+    }}
+    for i in range(4):  # identical per-layer layout -> one shared program
+        tree["params"][f"layer_{i}"] = {
+            "q": {"kernel_int8": rng.integers(-127, 128, (8, 8), np.int8),
+                  "scale": rng.standard_normal((1, 8)).astype(np.float32)},
+            "norm": {"scale": np.ones((8,), np.float32)},
+        }
+    path = tmp_path / "p.fpk"
+    flatpack.save(path, tree)
+
+    host = flatpack.load(path)
+    import jax
+
+    def check(dev):
+        flat_h = dict(flatpack._flatten(host))
+        flat_d = dict(flatpack._flatten(jax.device_get(dev)))
+        assert flat_h.keys() == flat_d.keys()
+        for k in flat_h:
+            assert flat_h[k].dtype == flat_d[k].dtype, k
+            np.testing.assert_array_equal(
+                np.asarray(flat_h[k]).view(np.uint8),
+                np.asarray(flat_d[k]).view(np.uint8), err_msg=str(k))
+
+    before = len(flatpack._unpack_cache)
+    check(flatpack.device_load(path))
+    # every leaf here is < 1 MB, so the default load rides the global
+    # small-leaf buckets: one program per itemsize present (i8/bf16/f32)
+    assert len(flatpack._unpack_cache) - before <= 3
+    # force the BIG-leaf path (the 8B production route): small_leaf_bytes
+    # 0 makes every leaf chunk by (subtree, itemsize), and a tiny
+    # chunk_bytes forces intra-subtree splits — parity must hold and the
+    # 4 identical layers must SHARE their per-width programs
+    before = len(flatpack._unpack_cache)
+    check(flatpack.device_load(path, chunk_bytes=256,
+                               small_leaf_bytes=0))
+    grown = len(flatpack._unpack_cache) - before
+    # layers share signatures: programs grow by the distinct layouts of
+    # (embed, final_norm, ONE layer's chunks), not by 4x layers
+    assert 0 < grown <= 6, grown
+
+
+def test_flatpack_device_load_64bit_falls_back_to_host(tmp_path):
+    """64-bit leaves cannot ride the staged bitcast path (device_put
+    would canonicalize the uint64 staging buffer to uint32 under default
+    x64-off and silently corrupt values): device_load must return the
+    host tree instead, bit-identical to load()."""
+    from lambdipy_tpu.bundle import flatpack
+
+    tree = {"a": np.arange(2**33, 2**33 + 8, dtype=np.int64),
+            "b": np.ones((4, 4), np.float32)}
+    path = tmp_path / "x64.fpk"
+    flatpack.save(path, tree)
+    out = flatpack.device_load(path)
+    assert out["a"].dtype == np.int64
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    np.testing.assert_array_equal(out["b"], tree["b"])

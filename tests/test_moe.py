@@ -191,6 +191,7 @@ def test_moe_group_padding_tokens_never_seated():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # heavyweight parity; subsystem keeps a fast test
 def test_moe_dispatch_cost_is_linear_in_tokens():
     """The [g, gs, e, c] dispatch tensor grows linearly with tokens: per-
     group capacity is constant, unlike the old global capacity ∝ t."""

@@ -152,6 +152,7 @@ def test_llama_pipeline_forward_composes_with_dp(cpu_devices):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # heavyweight parity; subsystem keeps a fast test
 def test_pipeline_forward_with_moe_blocks(cpu_devices):
     """MoE blocks trace inside the pipeline's manual region: expert
     sharding hints are suppressed there (no whole-mesh constraints inside

@@ -56,6 +56,7 @@ def test_ring_attention_composes_with_dp(cpu_devices):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # heavyweight parity; subsystem keeps a fast test
 def test_llama_ring_backend_matches_dense(cpu_devices):
     """Llama prefill with attn_backend='ring' on an sp mesh must match the
     dense single-device forward — the long-context serving path."""

@@ -16,7 +16,12 @@ from lambdipy_tpu.recipes.schema import load_recipe_dict
 def make_model_bundle(tmp_path, *, model="llama-tiny", handler, extra=None,
                       mesh=None):
     """Build a tiny model bundle end-to-end (vendor nothing; base layer
-    provides jax; payload params initialized at build time)."""
+    provides jax; payload params initialized at build time). Serving-
+    program AOT snapshots default OFF here — every warmed boot would pay
+    exports + round-trip compiles on the 1-core box; the feature has its
+    own test (test_aot) and stays default-ON in production bundles."""
+    extra = dict(extra or ())
+    extra.setdefault("serve_aot", "0")
     doc = {
         "schema": 1,
         "name": f"test-{model}",
@@ -509,3 +514,60 @@ def test_generate_handler_serves_compile_once(llama_bundle):
         "tokens": [4, 5, 6, 7, 8], "temperature": 0.9, "top_k": 3,
         "seed": 5})
     assert r1["ok"] and r2["ok"]
+
+
+def test_bundle_params_from_checkpoint_path(tmp_path):
+    """payload.params may be a checkpoint PATH (the schema's third form —
+    real deployments ship pre-built weights instead of build-time init):
+    a params dir or a bare .fpk is linked/copied into the bundle and the
+    served weights are EXACTLY the provided ones, not a fresh init."""
+    import numpy as np
+
+    from lambdipy_tpu.bundle.flatpack import save_checkpoint_files
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.recipes.schema import load_recipe_dict
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.bundle import assemble_bundle
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    # distinctive weights: seed 7, not the handler default of 0
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=7)
+    src_dir = tmp_path / "ckpt"
+    save_checkpoint_files(src_dir, params, "fpk")
+
+    for src in (src_dir, src_dir / "params.fpk"):  # dir AND bare-file form
+        doc = {
+            "schema": 1, "name": "test-path-params", "version": "0.1",
+            "device": "any", "base_layer": "jax-tpu", "requires": [],
+            "payload": {
+                "model": "llama-tiny",
+                "handler": "lambdipy_tpu.runtime.handlers:generate_handler",
+                "params": str(src), "dtype": "float32",
+                "extra": {"max_new_tokens": "4"},
+            },
+        }
+        work = tmp_path / f"w-{src.name}"
+        result = build_recipe(load_recipe_dict(doc), work, run_smoke=False)
+        bundle = work / "bundle"
+        manifest = assemble_bundle(result, bundle, with_payload=True)
+        assert manifest["payload"]["params_info"]["format"] == "external"
+        report = load_bundle(bundle, warmup=False)
+        out = report.handler.invoke(report.state,
+                                    {"tokens": [1, 2, 3], "max_new_tokens": 4})
+        assert out["ok"], out
+        import jax.numpy as jnp
+
+        expected = adapter.generate(params, jnp.asarray([[1, 2, 3]],
+                                                        jnp.int32),
+                                    max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]),
+                                      np.asarray(expected))
+
+    import pytest as _pytest
+    doc["payload"]["params"] = str(tmp_path / "nope")
+    with _pytest.raises(Exception, match="neither"):
+        result = build_recipe(load_recipe_dict(doc), tmp_path / "w-bad",
+                              run_smoke=False)
+        assemble_bundle(result, tmp_path / "w-bad" / "bundle",
+                        with_payload=True)

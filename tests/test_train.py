@@ -1,5 +1,6 @@
 """Sharded train-step tests on the virtual CPU mesh (SURVEY.md §5.4)."""
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -97,6 +98,7 @@ def test_make_optimizer_accumulates_gradients():
     assert float(jnp.abs(u2["w"]).max()) > 0.0   # second: params move
 
 
+@pytest.mark.slow  # heavyweight parity; subsystem keeps a fast test
 def test_trainer_with_accumulation_and_schedule(cpu_devices, tmp_path):
     """The full Trainer loop runs with the upgraded optimizer stack."""
     from lambdipy_tpu.data.loader import ShardedLoader, TokenSource
