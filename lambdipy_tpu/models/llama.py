@@ -716,6 +716,34 @@ def _next_bucket(n: int, lo: int) -> int:
     return b
 
 
+def _lookup_draft(context, k: int, ngram_max: int = 3) -> list:
+    """Prompt-lookup drafting (host-side): propose the k tokens that
+    followed the most recent earlier occurrence of the context's current
+    suffix n-gram, falling back to repeating the last token.
+
+    No draft model exists or is needed: the draft source is the sequence
+    itself, which makes this free and surprisingly effective exactly
+    where speculative decoding pays off — repetitive continuations
+    (copying, templated output, and the cycles greedy decodes fall
+    into). A wrong draft costs nothing beyond the verify chunk whose
+    weight read was the point of the step anyway."""
+    import numpy as np
+
+    ctx = np.asarray(context, np.int64).reshape(-1)
+    n = ctx.size
+    for g in range(min(ngram_max, n - 1), 0, -1):
+        suffix = ctx[n - g:]
+        windows = np.lib.stride_tricks.sliding_window_view(ctx, g)[:n - g]
+        hits = np.nonzero((windows == suffix).all(axis=1))[0]
+        if hits.size:
+            start = int(hits[-1]) + g
+            cand = ctx[start:start + k]
+            out = np.full(k, ctx[-1], np.int64)
+            out[:cand.size] = cand
+            return out.tolist()
+    return [int(ctx[-1])] * k
+
+
 class LlamaServer:
     """Compile-once decode serving: prompt-length bucketing (pad right to a
     power of two) + sampling knobs as runtime operands.
@@ -747,6 +775,7 @@ class LlamaServer:
         self._aot = aot
         self._aot_loaded: set = set()
         self.aot_hits = 0  # programs served from the AOT store this boot
+        self.spec_stats: dict = {}  # last generate_speculative counters
         # default: anything the context window allows is servable (power-
         # of-two bucketing bounds distinct compiles at log2(max_len))
         self.decode_cap = decode_cap or model.cfg.max_len
@@ -1402,6 +1431,151 @@ class LlamaServer:
                     done = np.asarray(jax.device_get(carry[4]))[:b]
                     if bool(done.all()):
                         return
+
+    # -- speculative decoding ------------------------------------------------
+
+    def _spec_verify_fn(self, kb: int, cache_len: int):
+        """Compiled verify step for speculative decoding: run the pending
+        token + kb-1 draft tokens as ONE multi-token chunk (the scalar-
+        index continuation branch of the cache), greedily re-derive the
+        true successor at every position, and accept the longest draft
+        prefix that matches. Emits 1..kb tokens per WEIGHT READ — decode
+        is weight-bytes-bound, so accepted drafts are nearly free, which
+        is the only way past the 1-token-per-read decode roofline.
+        Rollback after partial acceptance is just the cache index: the
+        attention validity mask never exposes entries past it, so the
+        stale K/V written for rejected drafts is unreachable."""
+        def build():
+            def vf(params, draft, tok, cache):
+                idx = cache[0]["index"].reshape(())  # scalar-index branch
+                cache = [{**c, "index": idx} for c in cache]
+                chunk = jnp.concatenate(
+                    [tok.reshape(1, 1), draft[:, :kb - 1]], axis=1)
+                positions = (idx + jnp.arange(kb))[None, :]
+                logits, new_cache = self.model.apply(
+                    params, chunk, positions=positions, cache=cache)
+                lg = logits[0].astype(jnp.float32)          # [kb, v]
+                g = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # true succ.
+                ok = (g[:kb - 1] == draft[0, :kb - 1]).astype(jnp.int32)
+                m = jnp.sum(jnp.cumprod(ok))                # 0..kb-1
+                count = m + 1          # emitted: [tok, d_0..d_{m-1}]
+                # logprob of the GREEDY token at each position: equals
+                # the accepted draft's logprob where drafts match, and is
+                # the right value for the new pending token where the
+                # draft was rejected
+                logz = jax.nn.logsumexp(lg, axis=-1)
+                lp_g = jnp.take_along_axis(
+                    lg, g[:, None], axis=1)[:, 0] - logz
+                new_tok = jax.lax.dynamic_slice(g, (m,), (1,))
+                new_idx = idx + count
+                for entry in new_cache:
+                    entry["index"] = new_idx
+                return chunk[0], lp_g, count, new_tok, new_cache
+
+            return jax.jit(vf)
+
+        return self._fn_cached(("spec", kb, cache_len), build)
+
+    def generate_speculative(self, prompt_tokens, *, max_new_tokens: int,
+                             k: int = 8, eos_id: int | None = None,
+                             return_logprobs: bool = False,
+                             return_stats: bool = False,
+                             ngram_max: int = 3):
+        """Greedy decode with prompt-lookup speculative verification
+        (single row). In exact arithmetic the output is BITWISE
+        :meth:`generate`'s greedy output — speculation only changes how
+        many tokens each weight read verifies, never the argmax — and
+        the CPU f32 tests assert that equality. On bf16 hardware an
+        argmax whose top-2 logit gap sits below bf16 resolution can
+        break differently between the chunked verification forward and
+        the one-token step (measured on v5e at 8B: first divergence at a
+        0.006 logit gap); every emitted token is still the argmax of a
+        forward over the correct emitted prefix, i.e. the result is a
+        valid greedy decode under the chunked forward's numerics — the
+        same caveat class as batch-shape-dependent reductions. Returns
+        the same ``[1, max_new_tokens]`` array (plus logprobs when
+        asked), with ``self.spec_stats`` recording the step/acceptance
+        counters of the last call."""
+        import numpy as np
+
+        cfg = self.model.cfg
+        rows, lengths = self._normalize_prompts(prompt_tokens)
+        if len(rows) != 1:
+            raise ValueError("speculative decoding is single-row")
+        s = lengths[0]
+        self._validate(s, max_new_tokens)
+        kb = max(2, _next_bucket(max(2, int(k)), 2))
+        if max_new_tokens == 0 or s + max_new_tokens + kb > cfg.max_len:
+            # no room for a full verify chunk near the context boundary
+            out = self.generate(rows[0], max_new_tokens=max_new_tokens,
+                                eos_id=eos_id,
+                                return_logprobs=return_logprobs)
+            stats = {"fallback": "plain", "steps": max_new_tokens,
+                     "emitted": max_new_tokens, "tokens_per_step": 1.0,
+                     "k": kb}
+            self.spec_stats = stats
+            return (out, stats) if return_stats else out
+        cache_len = cfg.max_len
+        sb = min(_next_bucket(s, self.min_bucket), cache_len)
+        # prefill keyed at the streaming default segment: the prefill
+        # program does not depend on the segment size, so every k (and
+        # the streaming path itself) shares ONE compiled prefill per
+        # bucket instead of compiling a byte-identical copy per k
+        prefill, _ = self._stream_fns(1, sb, cache_len, 16)
+        vf = self._spec_verify_fn(kb, cache_len)
+        prompt_op, length_op = self._pad_rows(rows, lengths, 1, sb)
+        knobs = self._knob_operands(0.0, None, None, 0, None)
+        with self._mesh_ctx():
+            tok, lp0, cache, _pos, _done, _rng = prefill(
+                self.params, prompt_op, length_op, *knobs)
+        pending, pending_lp = (
+            float(x) for x in jax.device_get((tok[0], lp0[0])))
+        pending = int(pending)
+        emitted: list[int] = []
+        lps: list[float] = []
+        context = list(map(int, rows[0]))
+        steps = 0
+        while len(emitted) < max_new_tokens:
+            draft = _lookup_draft(context + [pending], kb,
+                                  ngram_max=ngram_max)
+            draft_op = jnp.asarray([draft], jnp.int32)
+            with self._mesh_ctx():
+                chunk, lp_next, count, new_tok, cache = vf(
+                    self.params, draft_op, tok, cache)
+            chunk_h, lp_h, cnt, new_h = jax.device_get(
+                (chunk, lp_next, count, new_tok))
+            cnt = int(cnt)
+            steps += 1
+            emitted.extend(int(t) for t in chunk_h[:cnt])
+            lps.append(pending_lp)
+            lps.extend(float(x) for x in lp_h[:cnt - 1])
+            pending, pending_lp = int(new_h[0]), float(lp_h[cnt - 1])
+            tok = new_tok
+            context = context[:len(rows[0])] + emitted
+            if eos_id is not None and eos_id in chunk_h[:cnt]:
+                break
+        stats = {"steps": steps, "emitted": len(emitted),
+                 "tokens_per_step": round(
+                     len(emitted) / max(1, steps), 2), "k": kb}
+        # kept as a convenience for single-threaded callers/tests; the
+        # thread-safe channel is return_stats (a threaded server must not
+        # read another request's counters)
+        self.spec_stats = stats
+        toks = emitted[:max_new_tokens]
+        lps = lps[:max_new_tokens]
+        # eos latch parity with the fused path: truncate + fill
+        if eos_id is not None and eos_id in toks:
+            cut = toks.index(eos_id) + 1
+            toks = toks[:cut] + [eos_id] * (max_new_tokens - cut)
+            lps = lps[:cut] + [0.0] * (max_new_tokens - cut)
+        # pad (loop may break early only on eos; otherwise it fills)
+        toks += [eos_id if eos_id is not None else 0] * \
+            (max_new_tokens - len(toks))
+        lps += [0.0] * (max_new_tokens - len(lps))
+        out = np.asarray([toks], np.int32)
+        if return_logprobs:
+            out = (out, np.asarray([lps], np.float32))
+        return (out, stats) if return_stats else out
 
     @staticmethod
     def _normalize_prompts(prompt_tokens):

@@ -515,8 +515,28 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             if len(prompt) != 1:
                 return {"ok": False,
                         "error": "prefix caching is single-row"}
+        spec_k = req.get("speculative")
+        if spec_k is not None:
+            try:
+                spec_k = int(spec_k)
+            except (TypeError, ValueError):
+                return {"ok": False,
+                        "error": "speculative must be an integer draft "
+                                 "length"}
+            if server is None:
+                return {"ok": False, "error":
+                        "speculative decoding needs the compile-once "
+                        "server"}
+            if sample_kwargs["temperature"] > 0.0:
+                return {"ok": False, "error":
+                        "speculative decoding is greedy-only (send "
+                        "temperature 0)"}
+            if len(prompt) != 1 or prefix is not None:
+                return {"ok": False, "error":
+                        "speculative decoding is single-row without "
+                        "prefix"}
         return (prompt, max_new, sample_kwargs, from_text, prefix,
-                bool(req.get("logprobs")))
+                bool(req.get("logprobs")), spec_k)
 
     def invoke(req: dict) -> dict:
         parsed = _parse(req)
@@ -540,12 +560,25 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             _maybe_start_bucket_warm()
 
     def _invoke_parsed(parsed) -> dict:
-        prompt, max_new, sample_kwargs, from_text, prefix, want_lp = parsed
+        (prompt, max_new, sample_kwargs, from_text, prefix, want_lp,
+         spec_k) = parsed
         lps = None
         if want_lp and server is None:
             return {"ok": False,
                     "error": "logprobs need the compile-once server"}
-        if prefix is not None:
+        spec_stats = None
+        if spec_k is not None:
+            # greedy speculative decoding: prompt-lookup drafts verified
+            # in chunks — plain greedy output, fewer weight reads
+            # (models/llama.py generate_speculative). Stats come back
+            # with the call: instance state would race under the
+            # threaded server and go stale on the fallback path.
+            out_, spec_stats = server.generate_speculative(
+                prompt, max_new_tokens=max_new, k=spec_k,
+                eos_id=sample_kwargs["eos_id"], return_logprobs=want_lp,
+                return_stats=True)
+            toks, lps = out_ if want_lp else (out_, None)
+        elif prefix is not None:
             # shared-prefix KV reuse: only the suffix prefills per request
             out_ = server.generate(prompt, max_new_tokens=max_new,
                                    prefix=prefix, return_logprobs=want_lp,
@@ -573,6 +606,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             out["eos_id"] = sample_kwargs["eos_id"]
         if prefix is not None:
             out["prefix_cached"] = True
+        if spec_stats is not None:
+            out["speculative"] = spec_stats
         if from_text:
             row = toks[0].tolist()
             eos = sample_kwargs["eos_id"]
@@ -589,7 +624,16 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         if isinstance(parsed, dict):
             yield parsed
             return
-        prompt, max_new, sample_kwargs, from_text, prefix, want_lp = parsed
+        (prompt, max_new, sample_kwargs, from_text, prefix, want_lp,
+         spec_k) = parsed
+        if spec_k is not None:
+            # speculation doesn't stream (yet): silently serving plain
+            # decode would let clients benchmark the wrong thing
+            yield {"ok": False, "error":
+                   "speculative decoding does not compose with stream "
+                   "(segments already bound time-to-first-token); drop "
+                   "one of the two knobs"}
+            return
         # clamp the client's segment size to a pow-2 in [4, 64]: it is
         # part of the compiled-program key, and an arbitrary per-request
         # value would grow the program cache (and pay a compile) without

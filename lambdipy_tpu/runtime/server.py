@@ -56,7 +56,8 @@ def _openai_to_internal(req: dict) -> tuple[dict, str | None]:
         internal["top_p"] = float(req.get("top_p", 1.0))
     except (TypeError, ValueError) as e:
         return {}, f"max_tokens/temperature/top_p must be numbers: {e}"
-    for knob in ("top_k", "seed", "eos_id", "prefix", "segment"):
+    for knob in ("top_k", "seed", "eos_id", "prefix", "segment",
+                 "speculative"):
         if req.get(knob) is not None:
             internal[knob] = req[knob]
     lp = req.get("logprobs")

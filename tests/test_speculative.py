@@ -1,0 +1,137 @@
+"""Speculative decoding (prompt-lookup drafts + chunked verification):
+bitwise greedy parity, acceptance accounting, eos/logprob behavior."""
+
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models import registry
+from lambdipy_tpu.models.llama import _lookup_draft
+
+
+@pytest.fixture(scope="module")
+def tiny_server():
+    adapter = registry.get("llama-tiny").build()
+    return adapter.make_server(adapter.init_params(seed=0))
+
+
+def test_lookup_draft_follows_repeats():
+    # ...5, 6, 7 appeared before; drafting after [5, 6, 7] proposes what
+    # followed last time
+    ctx = [1, 5, 6, 7, 8, 9, 2, 5, 6, 7]
+    assert _lookup_draft(ctx, 3) == [8, 9, 2]
+    # no match anywhere -> repeat the last token
+    assert _lookup_draft([1, 2, 3], 3) == [3, 3, 3]
+    # partial candidate padded with the last token
+    assert _lookup_draft([4, 9, 9, 4], 3)[0] == 9
+
+
+def test_speculative_matches_plain_greedy(tiny_server):
+    """The core guarantee: speculative output is BITWISE the plain greedy
+    output for any k (drafts change the verification batching, never the
+    chosen tokens)."""
+    for prompt in ([1, 2, 3, 4, 5], [9, 8, 7], list(range(1, 30))):
+        ref = tiny_server.generate(prompt, max_new_tokens=24)
+        for k in (2, 4, 8):
+            out = tiny_server.generate_speculative(
+                prompt, max_new_tokens=24, k=k)
+            np.testing.assert_array_equal(
+                out, ref, err_msg=f"prompt={prompt[:3]}... k={k}")
+
+
+def test_speculative_accepts_on_repetitive_decode(tiny_server):
+    """Greedy decodes of the tiny model fall into cycles; once they do,
+    prompt-lookup drafts verify several tokens per step — the counters
+    must show >1 token per weight read."""
+    out = tiny_server.generate([5, 6, 7, 8], max_new_tokens=48)
+    spec = tiny_server.generate_speculative([5, 6, 7, 8],
+                                            max_new_tokens=48, k=8)
+    np.testing.assert_array_equal(spec, out)
+    stats = tiny_server.spec_stats
+    assert stats["emitted"] >= 48
+    assert stats["tokens_per_step"] > 1.0, stats
+    assert stats["steps"] < 48, stats
+
+
+def test_speculative_eos_matches_fused_latch(tiny_server):
+    free = tiny_server.generate([5, 6, 7, 8], max_new_tokens=10)[0]
+    eos = int(free[3])
+    ref = tiny_server.generate([5, 6, 7, 8], max_new_tokens=10, eos_id=eos)
+    out = tiny_server.generate_speculative([5, 6, 7, 8], max_new_tokens=10,
+                                           k=4, eos_id=eos)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_speculative_logprobs_match_plain(tiny_server):
+    rt, rl = tiny_server.generate([1, 2, 3], max_new_tokens=12,
+                                  return_logprobs=True)
+    st, sl = tiny_server.generate_speculative([1, 2, 3], max_new_tokens=12,
+                                              k=4, return_logprobs=True)
+    np.testing.assert_array_equal(st, rt)
+    np.testing.assert_allclose(sl, rl, rtol=1e-4, atol=1e-4)
+
+
+def test_speculative_near_window_falls_back(tiny_server):
+    """No room for a verify chunk near max_len (128 on llama-tiny): the
+    call degrades to the plain path with identical output."""
+    prompt = list(range(1, 100))
+    ref = tiny_server.generate(prompt, max_new_tokens=28)
+    out = tiny_server.generate_speculative(prompt, max_new_tokens=28, k=8)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_speculative_rejects_single_row_batches(tiny_server):
+    with pytest.raises(ValueError, match="single-row"):
+        tiny_server.generate_speculative([[1, 2], [3, 4]],
+                                         max_new_tokens=4)
+
+
+def test_handler_speculative_knob(tmp_path):
+    """`"speculative": k` on /invoke routes through speculative decoding:
+    same tokens as the plain request, plus acceptance counters; invalid
+    combinations get clean API errors."""
+    from tests.test_runtime import make_model_bundle
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "16"})
+    report = load_bundle(bundle, warmup=False)
+    plain = report.handler.invoke(report.state, {"tokens": [5, 6, 7, 8]})
+    spec = report.handler.invoke(report.state,
+                                 {"tokens": [5, 6, 7, 8],
+                                  "speculative": 4})
+    assert spec["ok"], spec
+    assert spec["tokens"] == plain["tokens"]
+    assert spec["speculative"]["emitted"] >= 16
+    bad = report.handler.invoke(report.state,
+                                {"tokens": [1, 2], "speculative": 4,
+                                 "temperature": 0.7})
+    assert not bad["ok"] and "greedy-only" in bad["error"]
+    bad2 = report.handler.invoke(report.state,
+                                 {"tokens": [[1, 2], [3, 4]],
+                                  "speculative": 4})
+    assert not bad2["ok"]
+
+
+def test_speculative_stats_fallback_and_stream_rejection(tmp_path):
+    """The fallback path returns its own stats (never another request's),
+    and stream + speculative is a clean error instead of a silent plain
+    decode."""
+    from tests.test_runtime import make_model_bundle
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "8"})
+    report = load_bundle(bundle, warmup=False)
+    # llama-tiny max_len=128: prompt 115 + 8 new + kb 8 > 128 -> fallback
+    long = report.handler.invoke(report.state,
+                                 {"tokens": list(range(1, 116)),
+                                  "speculative": 8, "max_new_tokens": 8})
+    assert long["ok"], long
+    assert long["speculative"].get("fallback") == "plain", long["speculative"]
+    chunks = list(report.state.invoke_stream(
+        {"tokens": [1, 2, 3], "speculative": 8, "stream": True}))
+    assert chunks[0]["ok"] is False and "stream" in chunks[0]["error"]
