@@ -760,7 +760,7 @@ class LlamaServer:
     def __init__(self, model: LlamaModel, params, *, mesh=None,
                  min_bucket: int = 16, decode_cap: int | None = None,
                  prefix_cache_max: int = 4, program_cache_max: int = 64,
-                 aot=None):
+                 prefill_chunk: int | None = None, aot=None):
         self.model = model
         self.params = params
         self.mesh = mesh
@@ -776,6 +776,24 @@ class LlamaServer:
         self._aot_loaded: set = set()
         self.aot_hits = 0  # programs served from the AOT store this boot
         self.spec_stats: dict = {}  # last generate_speculative counters
+        # chunked prefill: prompts longer than this prefill through
+        # fixed-width chunks against the growing KV cache instead of one
+        # wide program. Memory for dense attention drops from O(s^2) to
+        # O(chunk x s) — an 8k dense prefill's [h, s, s] f32 scores are
+        # 8.6 GB in one shot but bounded at chunk width chunked — and
+        # program count stays O(1) in prompt length. None = off.
+        # The chunk width MUST divide max_len: every chunk (padded last
+        # one included) writes its full width at a multiple-of-chunk
+        # offset, and a write window crossing max_len would be CLAMPED by
+        # dynamic_update_slice — silently overwriting real prefix KV.
+        # Halve until it divides; disable if nothing >= min_bucket does.
+        self.prefill_chunk = None
+        if prefill_chunk:
+            ck = max(self.min_bucket, _next_bucket(prefill_chunk, 16))
+            while ck >= self.min_bucket and model.cfg.max_len % ck:
+                ck //= 2
+            if ck >= self.min_bucket:
+                self.prefill_chunk = ck
         # default: anything the context window allows is servable (power-
         # of-two bucketing bounds distinct compiles at log2(max_len))
         self.decode_cap = decode_cap or model.cfg.max_len
@@ -874,6 +892,10 @@ class LlamaServer:
         kind = key[0]
         if kind in ("stream", "prefix", "continue", "stream_prefix"):
             return f"srv-{kind}-" + "-".join(map(str, key[1:]))
+        # "prefix_ext" stays un-AOT-able on purpose: it donates its cache
+        # argument, which the store's double-call probe would invalidate
+        # between calls — and warmup never compiles it, so there would be
+        # nothing to snapshot anyway
         return None
 
     def _aot_examples(self, key: tuple):
@@ -1171,12 +1193,9 @@ class LlamaServer:
             with self._prefix_lock:
                 self._prefix_inflight.pop(key).set()
 
-    def _prefill_prefix(self, key: str, rows, lengths) -> str:
-        cfg = self.model.cfg
-        s = lengths[0]
-        sb = min(_next_bucket(s, self.min_bucket), cfg.max_len)
-        cache_len = cfg.max_len
-
+    def _prefix_first_fn(self, sb: int, cache_len: int):
+        """First-chunk prefix prefill: embed the (padded) chunk into a
+        full-window cache, index = true length."""
         def build():
             def pf(params, prompt, length):
                 _, prefill_cache = self.model.apply(
@@ -1190,10 +1209,62 @@ class LlamaServer:
 
             return jax.jit(pf)
 
-        pf_fn = self._fn_cached(("prefix", sb, cache_len), build)
-        prompt_op, _ = self._pad_rows(rows, lengths, 1, sb)
+        return self._fn_cached(("prefix", sb, cache_len), build)
+
+    def _prefix_ext_fn(self, sbs: int):
+        """Extend a full-window prefix cache by one PADDED chunk (no token
+        selection; lm_head at one position so the vocab matmul is
+        skipped). Every chunk except the last must be full-width: the
+        scalar-index write covers the whole padded chunk, the NEXT
+        chunk's write overwrites those padding cells, and the final
+        ragged chunk's padding stays unreachable behind the cache
+        index."""
+        def build():
+            def ext(params, cache, chunk, chunk_len):
+                idx = cache[0]["index"].reshape(())
+                cache = [{**c, "index": idx} for c in cache]
+                positions = (idx + jnp.arange(sbs))[None, :]
+                _, new_cache = self.model.apply(
+                    params, chunk, positions=positions, cache=cache,
+                    logit_positions=jnp.zeros((1,), jnp.int32))
+                for entry in new_cache:
+                    entry["index"] = idx + chunk_len
+                return new_cache
+
+            # donate the incoming cache: it is single-owner inside the
+            # chunk loop, and without donation every ext call copies the
+            # full-window KV (multi-GB at 8B) to write one chunk
+            return jax.jit(ext, donate_argnums=(1,))
+
+        return self._fn_cached(("prefix_ext", sbs), build)
+
+    def _prefill_prefix(self, key: str, rows, lengths) -> str:
+        cfg = self.model.cfg
+        s = lengths[0]
+        cache_len = cfg.max_len
+        ck = self.prefill_chunk
         with self._mesh_ctx():
-            cache = pf_fn(self.params, prompt_op, jnp.int32(s))
+            if ck and s > ck:
+                # chunked: bounded attention memory (O(ck x s), not
+                # O(s^2)) and O(1) compiled programs in prompt length
+                head = rows[0][:ck]
+                pf_fn = self._prefix_first_fn(ck, cache_len)
+                prompt_op, _ = self._pad_rows([head], [ck], 1, ck)
+                cache = pf_fn(self.params, prompt_op, jnp.int32(ck))
+                ext = self._prefix_ext_fn(ck)
+                pos = ck
+                while pos < s:
+                    n = min(ck, s - pos)
+                    chunk_op, _ = self._pad_rows(
+                        [rows[0][pos:pos + n]], [n], 1, ck)
+                    cache = ext(self.params, cache, chunk_op,
+                                jnp.int32(n))
+                    pos += n
+            else:
+                sb = min(_next_bucket(s, self.min_bucket), cfg.max_len)
+                pf_fn = self._prefix_first_fn(sb, cache_len)
+                prompt_op, _ = self._pad_rows(rows, lengths, 1, sb)
+                cache = pf_fn(self.params, prompt_op, jnp.int32(s))
         with self._prefix_lock:
             self._prefixes[key] = (cache, s)
             while len(self._prefixes) > self._prefix_cache_max:

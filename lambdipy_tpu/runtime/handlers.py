@@ -298,6 +298,10 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # bucket diversity (rising program_evictions in /metrics
             # means it is too small)
             server_caps["program_cache_max"] = int(extra["program_cache_max"])
+        if extra.get("prefill_chunk") is not None:
+            # long prefixes prefill in fixed-width chunks: dense-attention
+            # memory O(chunk x s) instead of O(s^2), O(1) programs
+            server_caps["prefill_chunk"] = int(extra["prefill_chunk"])
         if mesh is None and getattr(ctx, "bundle_dir", None) is not None \
                 and str(extra.get("serve_aot", "1")) != "0":
             # serving programs ride the bundle's AOT exec tier: at real

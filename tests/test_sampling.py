@@ -488,3 +488,61 @@ def test_stream_with_prefix_matches_fused_and_full(tiny_llama):
     ref = server.generate(suffix, max_new_tokens=8, prefix=prefix,
                           eos_id=eos)
     np.testing.assert_array_equal(out, ref[:, :out.shape[1]])
+
+
+def test_chunked_prefix_prefill_matches_wide(tiny_llama):
+    """prefill_chunk: long prefixes prefill through fixed-width chunks
+    (bounded attention memory, O(1) programs in prompt length) with
+    outputs identical to the one-wide-program path — greedy, seeded
+    sampled, and streamed."""
+    import numpy as np
+
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params = tiny_llama
+    wide = LlamaServer(adapter.module, params)
+    chunked = LlamaServer(adapter.module, params, prefill_chunk=16)
+    prefix = list(range(1, 60))  # 59 tokens -> chunks 16+16+16+11 (ragged)
+    suffix = [4, 5]
+    for kw in ({}, dict(temperature=0.8, top_k=5, seed=3)):
+        a = wide.generate(suffix, max_new_tokens=8, prefix=prefix, **kw)
+        b = chunked.generate(suffix, max_new_tokens=8, prefix=prefix, **kw)
+        np.testing.assert_array_equal(a, b, err_msg=f"kw={kw}")
+    full = wide.generate(prefix + suffix, max_new_tokens=8)
+    np.testing.assert_array_equal(
+        chunked.generate(suffix, max_new_tokens=8, prefix=prefix), full)
+    # streamed prefix over a chunked cache
+    st = np.concatenate(list(chunked.generate_stream(
+        suffix, max_new_tokens=8, segment=4, prefix=prefix)), axis=1)
+    np.testing.assert_array_equal(st, full)
+    # O(1) programs: a longer prefix reuses (first, ext) — zero new
+    # prefill compiles
+    count = len(chunked.buckets)
+    chunked.cache_prefix(list(range(1, 100)))
+    assert len(chunked.buckets) == count, chunked.buckets
+
+
+def test_chunked_prefill_requires_divisible_window(tiny_llama):
+    """A chunk width crossing max_len would be write-clamped into real
+    prefix KV: widths are auto-halved until they divide max_len, and
+    chunking disables (wide path serves) when nothing >= min_bucket
+    does."""
+    import dataclasses
+
+    import numpy as np
+
+    from lambdipy_tpu.models.llama import LlamaModel, LlamaServer
+
+    adapter, params = tiny_llama
+    cfg = dataclasses.replace(adapter.config, max_len=120)  # 8 * 15
+    srv = LlamaServer(LlamaModel(cfg), params, prefill_chunk=32)
+    assert srv.prefill_chunk is None
+    wide = LlamaServer(LlamaModel(cfg), params)
+    prefix = list(range(1, 40))
+    np.testing.assert_array_equal(
+        srv.generate([4, 5], max_new_tokens=4, prefix=prefix),
+        wide.generate([4, 5], max_new_tokens=4, prefix=prefix))
+    # 96 = 32 * 3: the requested width survives
+    cfg96 = dataclasses.replace(adapter.config, max_len=96)
+    assert LlamaServer(LlamaModel(cfg96), params,
+                       prefill_chunk=32).prefill_chunk == 32
