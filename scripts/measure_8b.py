@@ -279,6 +279,62 @@ def measure_cold_start(n_invokes: int = 5) -> dict:
     return record
 
 
+def measure_speculative(n_new: int = 64, k: int = 8) -> dict:
+    """Speculative decode at 8B on a cyclic continuation (the workload
+    class lookup-drafting exists for): tokens-per-weight-read and
+    effective tok/s vs the plain path and the 1-token-per-read roofline."""
+    import statistics
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _measure_rtt_ms
+    from lambdipy_tpu.bundle import flatpack
+    from lambdipy_tpu.models import registry
+
+    ensure_params(params_path())
+    params = flatpack.device_load(params_path())
+    for leaf in jax.tree.leaves(params)[-1:]:
+        float(jnp.asarray(leaf).astype(jnp.float32).sum())
+    adapter = registry.get("llama3-8b").build(
+        dtype="bfloat16", quant="int8", extra=dict(DIMS))
+    server = adapter.make_server(params)
+    rtt = _measure_rtt_ms(jax, jnp)
+    rec = {"dims": f"{DIMS['hidden']}x{DIMS['layers']}x{DIMS['vocab_size']}",
+           "rtt_ms": round(rtt, 1), "k": k, "n_new": n_new,
+           "measured_at": time.strftime("%Y-%m-%d")}
+    prompt = [17, 23, 5, 99, 41, 7, 123, 64] * 4
+
+    server.generate(prompt, max_new_tokens=n_new)  # compile + warm
+    times = [_timed(lambda: server.generate(prompt, max_new_tokens=n_new))
+             for _ in range(5)]
+    plain_ms = max(0.1, statistics.median(times) - rtt)
+    rec["plain_tok_s"] = round(n_new / (plain_ms / 1e3), 1)
+
+    spec0, stats = server.generate_speculative(
+        prompt, max_new_tokens=n_new, k=k, return_stats=True)
+    ref = server.generate(prompt, max_new_tokens=n_new)
+    rec["greedy_agreement"] = f"{int(np.sum(spec0[0] == ref[0]))}/{n_new}"
+    times = [_timed(lambda: server.generate_speculative(
+        prompt, max_new_tokens=n_new, k=k)) for _ in range(5)]
+    # the host loop pays one fetch RTT per verify step (+1 for prefill)
+    spec_ms = max(0.1, statistics.median(times)
+                  - rtt * (stats["steps"] + 1))
+    rec["spec_tok_s"] = round(n_new / (spec_ms / 1e3), 1)
+    rec["spec_stats"] = stats
+    from lambdipy_tpu.models.llama import LlamaConfig
+    from lambdipy_tpu.utils import roofline
+
+    cfg = LlamaConfig(**DIMS, quant="int8", dtype=jnp.bfloat16)
+    rec["roofline_plain_b1_tok_s"] = round(
+        roofline.llama_decode_tok_s_bound(
+            cfg, batch=1, cache_len=len(prompt) + n_new // 2), 1)
+    rec["speedup_vs_plain"] = round(rec["spec_tok_s"] / rec["plain_tok_s"],
+                                    2)
+    return rec
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", default="1,8")
@@ -286,9 +342,24 @@ def main() -> int:
     ap.add_argument("--cold-start", action="store_true",
                     help="measure the build->deploy->invoke cold start "
                          "instead of decode throughput")
+    ap.add_argument("--speculative", action="store_true",
+                    help="measure speculative vs plain b1 decode")
+    ap.add_argument("--k", type=int, default=8,
+                    help="draft length for --speculative")
     ap.add_argument("--publish", action="store_true",
                     help="record into BASELINE.json published.config5")
     args = ap.parse_args()
+    if args.speculative:
+        record = measure_speculative(n_new=args.n_new, k=args.k)
+        print(json.dumps(record, indent=2))
+        if args.publish:
+            path = REPO / "BASELINE.json"
+            doc = json.loads(path.read_text())
+            cfg5 = doc.setdefault("published", {}).setdefault("config5", {})
+            cfg5["speculative"] = record
+            path.write_text(json.dumps(doc, indent=2))
+            print(f"published -> {path}", file=sys.stderr)
+        return 0
     if args.cold_start:
         record = measure_cold_start()
         print(json.dumps(record, indent=2))
