@@ -135,3 +135,27 @@ def test_speculative_stats_fallback_and_stream_rejection(tmp_path):
     chunks = list(report.state.invoke_stream(
         {"tokens": [1, 2, 3], "speculative": 8, "stream": True}))
     assert chunks[0]["ok"] is False and "stream" in chunks[0]["error"]
+
+
+def test_speculative_bypasses_continuous_batcher(tmp_path):
+    """On a batch_mode='continuous' bundle a speculative request is
+    served solo through the spec path (never enqueued into the engine)
+    and still matches the engine-served plain output."""
+    from tests.test_runtime import make_model_bundle
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "8", "batch_mode": "continuous",
+               "batch_max": "2", "batch_segment": "4"})
+    report = load_bundle(bundle, warmup=False)
+    plain = report.handler.invoke(report.state, {"tokens": [5, 6, 7]})
+    spec = report.handler.invoke(report.state,
+                                 {"tokens": [5, 6, 7], "speculative": 4})
+    assert spec["ok"] and spec["tokens"] == plain["tokens"]
+    engine = report.state.stats()["batching"]
+    # exactly the ONE plain request rode the engine — a speculative
+    # request enqueued into it would make this 2
+    assert engine["requests_served"] == 1, engine
+    assert "speculative" in spec
