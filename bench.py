@@ -269,6 +269,36 @@ def _stage_decode8b() -> int:
     return 0
 
 
+def _attach_last_device_record(result: dict) -> None:
+    """Best-effort: copy the latest published on-chip measurements from
+    BASELINE.json into a CPU-fallback bench line."""
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BASELINE.json")
+        with open(path) as f:
+            pub = json.load(f).get("published", {})
+        note: dict = {}
+        c3 = pub.get("config3", {})
+        # only records actually measured ON the device qualify — a
+        # CPU-fallback publish here would recreate the misattribution
+        # this note exists to prevent
+        if c3.get("serve_overhead_p50_ms") is not None and \
+                c3.get("platform") not in ("cpu", None):
+            note["resnet_serve_p50_ms"] = c3["serve_overhead_p50_ms"]
+            note["resnet_measured_at"] = c3.get("measured_at")
+        c5 = pub.get("config5", {})
+        if c5.get("b1_decode_tok_s") is not None and \
+                c5.get("platform") not in ("cpu", None):
+            note["llama8b_b1_tok_s"] = c5["b1_decode_tok_s"]
+            note["llama8b_b8_tok_s"] = c5.get("b8_decode_tok_s")
+            note["llama8b_hbm_util"] = c5.get("b1_decode_hbm_util")
+            note["llama8b_measured_at"] = c5.get("measured_at")
+        if note:
+            result["last_published_device"] = note
+    except Exception:  # informational only — never break the bench line
+        pass
+
+
 def _timed(fn) -> float:
     t0 = time.monotonic()
     fn()
@@ -341,6 +371,15 @@ def main() -> int:
                         "ok" if err is None else err)
                     if data is not None:
                         result.update(data)
+            if label == "cpu":
+                # reaching the cpu attempt means the device attempt
+                # failed (e.g. a wedged transport — main() would have
+                # returned otherwise): attach the last on-chip record
+                # published through the real serve path so this line
+                # still tells the true story — CPU numbers here mean
+                # the TRANSPORT was down at bench time, not that the
+                # stack regressed
+                _attach_last_device_record(result)
             result["stages"] = stages_log
             print(json.dumps(result))
             return 0
