@@ -700,7 +700,13 @@ def _continue_prefill(model: LlamaModel, params, cache, suffix, suffix_len,
     logits, new_cache = model.apply(
         params, suffix, positions=positions, cache=cache,
         logit_positions=jnp.broadcast_to(suffix_len - 1, (1,)))
-    start = idx + suffix_len
+    # The carry must come out in the SEG-PROGRAM family's shapes: per-row
+    # (1,) index/pos, matching what _serve_prefill produces. The prefix
+    # cache's scalar index fed model.apply above (the multi-token chunk
+    # needs the scalar-index branch), but a scalar carry here would make
+    # the shared ('stream', ...) segment program silently retrace — and
+    # FAIL against its shape-strict AOT-loaded executable (ADVICE r4).
+    start = jnp.broadcast_to(idx + suffix_len, (1,))
     for entry in new_cache:
         entry["index"] = start
     rng, sub = jax.random.split(rng)
@@ -872,11 +878,27 @@ class LlamaServer:
         with self._fns_lock:
             fn = self._fns.get(key)  # a racer may have won meanwhile
             if fn is None:
-                fn = self._fns[key] = (loaded if loaded is not None
-                                       else build())
-                if loaded is not None:
-                    self._aot_loaded.add(key)
-                    self.aot_hits += 1
+                if loaded is None:
+                    fn = build()
+                else:
+                    # partial hits are real (ADVICE r4: the continuous
+                    # engine's pair only ever executes its seg half, so
+                    # the snapshot may hold one part): loaded parts are
+                    # used, missing parts fall back to the jit wrapper
+                    if all(p is not None for p in loaded):
+                        merged = list(loaded)
+                    else:
+                        built = build()
+                        built = (built if isinstance(built, tuple)
+                                 else (built,))
+                        merged = [l if l is not None else b
+                                  for l, b in zip(loaded, built)]
+                    fn = merged[0] if len(merged) == 1 else tuple(merged)
+                    for i, p in enumerate(loaded):
+                        if p is not None:
+                            self._aot_loaded.add((key, i))
+                            self.aot_hits += 1
+                self._fns[key] = fn
             while len(self._fns) > self._fns_max:
                 self._fns.popitem(last=False)
                 self._fn_evictions += 1
@@ -890,7 +912,14 @@ class LlamaServer:
         if isinstance(key[0], int):  # fused decode (b, sb, steps)
             return "srv-dec-" + "-".join(map(str, key))
         kind = key[0]
-        if kind in ("stream", "prefix", "continue", "stream_prefix"):
+        if kind == "stream_prefix":
+            # "2": the continuation carry's index/pos went scalar ->
+            # (1,) (ADVICE r4 medium); a pre-fix bundle's aot/ dir may
+            # persist across upgrade, and its stale executable would
+            # re-create the exact carry-shape mismatch the fix removes.
+            # A new name orphans the old artifact instead of loading it.
+            return "srv-stream_prefix2-" + "-".join(map(str, key[1:]))
+        if kind in ("stream", "prefix", "continue", "spec"):
             return f"srv-{kind}-" + "-".join(map(str, key[1:]))
         # "prefix_ext" stays un-AOT-able on purpose: it donates its cache
         # argument, which the store's double-call probe would invalidate
@@ -944,11 +973,22 @@ class LlamaServer:
             _, sbs = key
             return [(prefix_cache(cfg.max_len),
                      jnp.zeros((1, sbs), jnp.int32), jnp.int32(1), *knobs)]
+        if kind == "spec":
+            # verify inputs are scalar-index (generate_speculative
+            # normalizes the prefill carry before the first call)
+            _, kb, cache_len = key
+            return [(jnp.zeros((1, kb), jnp.int32),
+                     jnp.zeros((1,), jnp.int32), prefix_cache(cache_len))]
         return None
 
     def _aot_load(self, key: tuple):
-        """Best-effort load of the key's program(s) from the AOT store;
-        returns the callable (or pair) only when EVERY part hits."""
+        """Best-effort load of the key's program(s) from the AOT store.
+        Returns a list aligned with the key's parts — loaded executable
+        per hit, None per miss — or None when nothing hit at all.
+        Multi-part keys (the streaming pair) load PARTIALLY: the
+        continuous engine only ever runs a pair's seg half, so a
+        snapshot legitimately holds one part (ADVICE r4) and the boot
+        should still skip that compile."""
         name = self._aot_name(key)
         if name is None:
             return None
@@ -957,7 +997,7 @@ class LlamaServer:
         # never-saved key (first boots, fresh prefix buckets)
         names = [name] if not isinstance(key[0], str) or \
             key[0] != "stream" else [f"{name}-p0", f"{name}-p1"]
-        if not all(self._aot.has(n) for n in names):
+        if not any(self._aot.has(n) for n in names):
             return None
         try:
             examples = self._aot_examples(key)
@@ -967,12 +1007,15 @@ class LlamaServer:
             return None
         parts = []
         for part_name, ex in zip(names, examples):
+            if not self._aot.has(part_name):
+                parts.append(None)
+                continue
             with self._mesh_ctx():
                 hit = self._aot.load(part_name, (self.params, *ex))
-            if hit is None:
-                return None
-            parts.append(hit[0])
-        return parts[0] if len(parts) == 1 else tuple(parts)
+            parts.append(None if hit is None else hit[0])
+        if not any(p is not None for p in parts):
+            return None
+        return parts
 
     def aot_save_all(self) -> int:
         """Snapshot every compiled serving program that was NOT itself
@@ -983,8 +1026,7 @@ class LlamaServer:
         if self._aot is None:
             return 0
         with self._fns_lock:
-            items = [(k, v) for k, v in self._fns.items()
-                     if k not in self._aot_loaded]
+            items = list(self._fns.items())
         n = 0
         for key, fn in items:
             name = self._aot_name(key)
@@ -997,17 +1039,23 @@ class LlamaServer:
             fns = fn if isinstance(fn, tuple) else (fn,)
             if len(fns) != len(examples):
                 continue
-            # only snapshot programs that actually COMPILED: a jit
-            # wrapper that never ran (e.g. the prefill half of a pair
-            # the continuous engine keyed but only uses the seg half of)
-            # would pay a fresh multi-second compile inside
-            # save_from_jitted's lower().compile() instead of the
-            # in-session cache hit the executed ones get
-            if any(getattr(part, "_cache_size", lambda: 0)() == 0
-                   for part in fns):
-                continue
-            wrote = 0
             for i, (part, ex) in enumerate(zip(fns, examples)):
+                with self._fns_lock:
+                    # saved (or AOT-loaded) once; a later call (e.g.
+                    # after the background bucket warm) must not
+                    # re-export it
+                    if (key, i) in self._aot_loaded:
+                        continue
+                # only snapshot parts that actually COMPILED: a jit
+                # wrapper that never ran (e.g. the prefill half of a
+                # pair the continuous engine only uses the seg half of)
+                # would pay a fresh multi-second compile inside
+                # save_from_jitted's lower().compile() instead of the
+                # in-session cache hit the executed ones get. Parts save
+                # INDEPENDENTLY (ADVICE r4): the executed half of a
+                # pair snapshots even when its sibling never ran.
+                if getattr(part, "_cache_size", lambda: 0)() == 0:
+                    continue
                 part_name = (name if len(examples) == 1
                              else f"{name}-p{i}")
                 try:
@@ -1017,15 +1065,13 @@ class LlamaServer:
                     # where exec cannot load (e.g. multi-device CPU)
                     meta = self._aot.save_from_jitted(
                         part_name, part, (self.params, *ex))
-                    wrote += len(meta.get("tiers", ()))
                 except Exception:  # noqa: BLE001 — AOT is best-effort
                     continue
-            if wrote:
-                n += wrote
-                with self._fns_lock:
-                    # saved once; a later call (e.g. after the background
-                    # bucket warm) must not re-export it
-                    self._aot_loaded.add(key)
+                wrote = len(meta.get("tiers", ()))
+                if wrote:
+                    n += wrote
+                    with self._fns_lock:
+                        self._aot_loaded.add((key, i))
         return n
 
     def _compiled(self, b: int, sb: int, steps: int):
@@ -1599,6 +1645,11 @@ class LlamaServer:
         with self._mesh_ctx():
             tok, lp0, cache, _pos, _done, _rng = prefill(
                 self.params, prompt_op, length_op, *knobs)
+        # normalize the prefill cache's per-row (1,) index to the scalar
+        # the verify fn itself writes: without this the first vf call
+        # traces a second shape variant, doubling the (multi-second
+        # remote) warm compile per ('spec', kb, cache_len) key (ADVICE r4)
+        cache = [{**c, "index": c["index"].reshape(())} for c in cache]
         pending, pending_lp = (
             float(x) for x in jax.device_get((tok[0], lp0[0])))
         pending = int(pending)

@@ -245,9 +245,15 @@ class ContinuousBatcher:
         row = np.asarray(prompt_row, np.int32).reshape(-1).tolist()
         s = len(row)
         if s + max_new_tokens > self.cache_len:
-            raise ValueError(
-                f"prompt {s} + max_new_tokens {max_new_tokens} exceeds the "
-                f"continuous-batching cache_len {self.cache_len}")
+            # a request over the engine's (operator-capped) cache_len is
+            # still servable solo — the same bundle served it before
+            # continuous mode existed, so don't turn the cap into a
+            # client-visible error (ADVICE r4); server._validate still
+            # rejects what the model itself can't hold
+            return self.server.generate(
+                row, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, eos_id=eos_id, return_logprobs=return_logprobs)
         self.server._validate(s, max_new_tokens)
 
         # prefill alone; the engine's segments emit the tokens (the scan

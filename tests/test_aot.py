@@ -193,3 +193,36 @@ def test_serving_programs_ride_aot_store(tmp_path):
     out = r2.handler.invoke(r2.state, {"tokens": [1, 2, 3]})
     ref = r1.handler.invoke(r1.state, {"tokens": [1, 2, 3]})
     assert out["ok"] and out["tokens"] == ref["tokens"]
+
+
+@pytest.mark.slow  # dual-tier exports on one core
+def test_partial_stream_pair_saves_and_loads(tmp_path):
+    """The continuous engine's B-slot ('stream', ...) pair only ever runs
+    its SEG half; the pair must still snapshot that half and a later
+    boot must load it while jit-building the never-saved prefill half
+    (ADVICE r4: all-or-nothing pairs left the most expensive continuous
+    compile unsnapshotted)."""
+    from lambdipy_tpu.models.llama import LlamaServer
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    store = AotStore(tmp_path, gate_ms=60000)
+    server = LlamaServer(adapter.module, params, aot=store)
+    cb = ContinuousBatcher(server, slots=4, segment=4)
+    ref = cb.generate([1, 2, 3], max_new_tokens=8)
+    assert server.aot_save_all() > 0
+    key = ("stream", 4, server.min_bucket, cb.cache_len, 4)
+    assert key in server.buckets
+    from lambdipy_tpu.models.llama import LlamaServer as LS
+
+    name = LS._aot_name(key)
+    assert store.has(f"{name}-p1"), "seg half must be snapshotted"
+    assert not store.has(f"{name}-p0"), "prefill half never ran"
+
+    server2 = LlamaServer(adapter.module, params,
+                          aot=AotStore(tmp_path, gate_ms=60000))
+    cb2 = ContinuousBatcher(server2, slots=4, segment=4)
+    out = cb2.generate([1, 2, 3], max_new_tokens=8)
+    np.testing.assert_array_equal(out, ref)
+    assert server2.aot_hits >= 1, "second boot must load the seg half"

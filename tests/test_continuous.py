@@ -114,10 +114,19 @@ def test_sampled_requests_bypass_to_solo(tiny_server):
     assert cb.stats()["segments_run"] == 0  # never touched the engine
 
 
-def test_overflow_rejected(tiny_server):
+def test_over_cache_len_falls_back_to_solo(tiny_server):
+    """A request over the engine's capped cache_len serves SOLO (the
+    bundle could serve it before continuous mode was enabled — the cap
+    must not become a client-visible error, ADVICE r4); what the model
+    itself can't hold still raises."""
     cb = ContinuousBatcher(tiny_server, slots=2, segment=4, cache_len=32)
-    with pytest.raises(ValueError, match="cache_len"):
-        cb.generate(list(range(1, 30)), max_new_tokens=16)
+    prompt = list(range(1, 30))
+    out = cb.generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(
+        out, tiny_server.generate(prompt, max_new_tokens=16))
+    assert cb.stats()["segments_run"] == 0  # never touched the engine
+    with pytest.raises(ValueError):  # beyond max_len: still an error
+        cb.generate(list(range(1, 100)), max_new_tokens=120)
 
 
 def test_engine_failure_surfaces_to_callers(tiny_server, monkeypatch):

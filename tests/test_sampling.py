@@ -546,3 +546,32 @@ def test_chunked_prefill_requires_divisible_window(tiny_llama):
     cfg96 = dataclasses.replace(adapter.config, max_len=96)
     assert LlamaServer(LlamaModel(cfg96), params,
                        prefill_chunk=32).prefill_chunk == 32
+
+
+def test_prefix_stream_shares_seg_program_without_retrace(tiny_llama):
+    """The prefix-continuation carry comes out in the seg family's
+    per-row shapes, so a prefix+stream request REUSES a plain stream's
+    compiled segment program instead of silently retracing it (ADVICE
+    r4 medium: the scalar-index carry doubled the remote compile and
+    broke against shape-strict AOT executables)."""
+    import numpy as np
+
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params = tiny_llama  # max_len = 128
+    server = LlamaServer(adapter.module, params)
+    # plain stream sized so its seg program is keyed at cache_len ==
+    # max_len (the prefix path's key): sb=16 + 32 segs * 4 > 128
+    list(server.generate_stream([1, 2, 3, 4, 5], max_new_tokens=112,
+                                segment=4))
+    count = server.compile_count
+    prefix = list(range(1, 20))
+    st = np.concatenate(list(server.generate_stream(
+        [4, 5], max_new_tokens=8, segment=4, prefix=prefix)), axis=1)
+    # exactly TWO new programs (the prefix first-prefill and the
+    # stream_prefix continuation); the seg program is shared with the
+    # plain stream — a retrace would show up as a THIRD traced shape on
+    # the pair's wrapper
+    assert server.compile_count == count + 2, server.buckets
+    full = server.generate(prefix + [4, 5], max_new_tokens=8)
+    np.testing.assert_array_equal(st, full)
