@@ -541,62 +541,82 @@ def filter_logits(logits, *, top_k: int | None = None, top_p: float | None = Non
 
 
 def filter_logits_runtime(logits, top_k, top_p):
-    """:func:`filter_logits` with the knobs as RUNTIME scalars, so one
+    """:func:`filter_logits` with the knobs as RUNTIME operands, so one
     compiled program serves every request (VERDICT r2 #3: static knobs
     forced a multi-second re-trace per novel sampling combination).
 
-    top_k: int32 scalar, <= 0 disables; top_p: f32 scalar, >= 1 disables.
-    Same sequential semantics as the static version (top-k filter, then
-    nucleus over the filtered distribution); the extra vocab-sized sort per
-    emitted token is noise next to the per-step matmuls.
+    top_k (int32) and top_p (f32) may be scalars or PER-ROW ``[b]``
+    vectors — batcher-fused rows each filter under their own request's
+    knobs (VERDICT r5 #2). <= 0 disables top_k, >= 1 disables top_p,
+    per row. Same sequential semantics as the static version (top-k
+    filter, then nucleus over the filtered distribution); the extra
+    vocab-sized sort per emitted token is noise next to the per-step
+    matmuls.
     """
     neg = jnp.float32(-1e30)
     v = logits.shape[-1]
+    rows = logits.shape[:-1]
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), rows)
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), rows)
     srt = jnp.sort(logits, axis=-1)[..., ::-1]
-    kth = jnp.take(srt, jnp.clip(top_k - 1, 0, v - 1), axis=-1)[..., None]
-    logits = jnp.where((top_k > 0) & (logits < kth), neg, logits)
+    kth = jnp.take_along_axis(
+        srt, jnp.clip(top_k - 1, 0, v - 1)[..., None], axis=-1)
+    logits = jnp.where((top_k > 0)[..., None] & (logits < kth), neg, logits)
     srt = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(srt, axis=-1)
-    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p[..., None]
     keep = keep.at[..., 0].set(True)
     thresh = jnp.min(jnp.where(keep, srt, jnp.float32(jnp.inf)),
                      axis=-1, keepdims=True)
-    return jnp.where((top_p < 1.0) & (logits < thresh), neg, logits)
+    return jnp.where((top_p < 1.0)[..., None] & (logits < thresh), neg,
+                     logits)
+
+
+def _split_rows(keys):
+    """Advance per-row PRNG chains one step: ``[b, 2]`` uint32 keys ->
+    (new keys ``[b, 2]``, per-row subkeys ``[b, 2]``). Each row's walk is
+    a function of ITS key alone — a row splits identically whether it
+    decodes solo or packed next to arbitrary traffic, which is what
+    makes sampled requests batchable (VERDICT r5 #2)."""
+    pair = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # [b, 2, 2]
+    return pair[:, 0], pair[:, 1]
 
 
 def _scan_decode(model: LlamaModel, params, select_fn, first, lp0, cache,
-                 start, done0, rng, eos_id, decode_steps: int,
+                 start, done0, keys, eos_id, decode_steps: int,
                  return_carry: bool = False):
     """The decode scan shared by the exact-shape path (:func:`_decode`),
     the bucketed serving path (:func:`_serve_decode`) and the streaming
     segment path: one compiled step per token over a static-shape cache.
-    ``eos_id`` is an int32 operand; < 0 disables eos latching (``done``
-    then never becomes True, so the filler value is never emitted).
-    Emits ``(tokens, logprobs)`` — each token's raw model logprob rides
-    along (one logsumexp per step, noise next to the forward); filler
-    tokens after eos carry logprob 0. ``return_carry`` additionally
-    returns the final (tok, lp, cache, pos, done, rng) carry so a later
-    segment can continue the decode exactly where this one stopped."""
+    ``eos_id`` is an int32 scalar or per-row ``[b]`` operand; < 0
+    disables eos latching for that row (``done`` then never becomes
+    True, so the filler value is never emitted). ``keys`` is the per-row
+    ``[b, 2]`` PRNG operand (:func:`_split_rows`). Emits ``(tokens,
+    logprobs)`` — each token's raw model logprob rides along (one
+    logsumexp per step, noise next to the forward); filler tokens after
+    eos carry logprob 0. ``return_carry`` additionally returns the final
+    (tok, lp, cache, pos, done, keys) carry so a later segment can
+    continue the decode exactly where this one stopped."""
     b = first.shape[0]
     has_eos = eos_id >= 0
 
     def step(carry, _):
-        tok, lp, cache, pos, done, rng = carry  # pos: int32 scalar or [b]
+        tok, lp, cache, pos, done, keys = carry  # pos: int32 scalar or [b]
         positions = (pos[:, None] if jnp.ndim(pos)
                      else jnp.broadcast_to(pos[None, None], (b, 1)))
         logits, new_cache = model.apply(params, tok[:, None],
                                         positions=positions, cache=cache)
         for entry in new_cache:
             entry["index"] = pos + 1
-        rng, sub = jax.random.split(rng)
-        nxt, nlp = select_fn(logits[:, -1, :].astype(jnp.float32), sub)
+        keys, subs = _split_rows(keys)
+        nxt, nlp = select_fn(logits[:, -1, :].astype(jnp.float32), subs)
         nxt = jnp.where(done, eos_id, nxt)
         nlp = jnp.where(done, jnp.float32(0.0), nlp)
         done = done | (has_eos & (nxt == eos_id))
-        return (nxt, nlp, new_cache, pos + 1, done, rng), (tok, lp)
+        return (nxt, nlp, new_cache, pos + 1, done, keys), (tok, lp)
 
     carry, (toks, lps) = jax.lax.scan(
-        step, (first, lp0, cache, start, done0, rng), None,
+        step, (first, lp0, cache, start, done0, keys), None,
         length=decode_steps)
     out = (jnp.transpose(toks), jnp.transpose(lps))  # [b, decode_steps] x2
     return (out, carry) if return_carry else out
@@ -617,9 +637,12 @@ def _serve_decode(model: LlamaModel, params, prompt, length, temperature,
     first sampled token reads row r's logits at ``length[r] - 1``.
 
     temperature (f32, <= 0 = greedy), top_k (int32, <= 0 = off), top_p
-    (f32, >= 1 = off), eos_id (int32, < 0 = none) and the PRNG key are all
-    traced operands: one compiled (sb, decode_steps) program serves every
-    sampling configuration and every prompt length in the bucket.
+    (f32, >= 1 = off), eos_id (int32, < 0 = none) and the PRNG keys are
+    all PER-ROW ``[b]`` traced operands (keys ``[b, 2]``): one compiled
+    (sb, decode_steps) program serves every sampling configuration and
+    every prompt length in the bucket, and batcher-fused rows each
+    decode under their own request's knobs and their own seed-derived
+    PRNG chain (VERDICT r5 #2).
     """
     select = _serve_select(temperature, top_k, top_p)
     carry = _serve_prefill(model, params, prompt, length, select, rng,
@@ -636,27 +659,33 @@ def _token_logprob(lg, tok):
 
 
 def _serve_select(temperature, top_k, top_p):
-    """Token-selection closure over runtime knob operands. Returns
-    ``(token, raw model logprob of token)``."""
+    """Token-selection closure over PER-ROW runtime knob operands
+    (scalar or ``[b]``; batcher-fused rows each select under their own
+    request's knobs). ``select(lg [b, v] f32, keys [b, 2])`` returns
+    ``(token [b], raw model logprob of token [b])`` — row r's draw uses
+    row r's subkey alone, so its tokens are independent of what shares
+    the batch (VERDICT r5 #2)."""
 
-    def select(lg, rng):
+    def select(lg, keys):
         lg = lg.astype(jnp.float32)
+        t_row = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32),
+                                 lg.shape[:-1])
+        greedy_tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
 
         def sampled(args):
-            lg, rng = args
-            t = jnp.maximum(temperature, jnp.float32(1e-6))
+            lg, keys = args
+            t = jnp.maximum(t_row, jnp.float32(1e-6))[:, None]
             filt = filter_logits_runtime(lg / t, top_k, top_p)
-            return jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
+            draw = jax.vmap(
+                lambda k, row: jax.random.categorical(k, row))(keys, filt)
+            # greedy rows inside a mixed batch keep their argmax
+            return jnp.where(t_row > 0, draw.astype(jnp.int32), greedy_tok)
 
-        def greedy(args):
-            lg, _ = args
-            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-
-        # cond, not where: greedy requests (temperature <= 0) must not pay
-        # the sampling path's two vocab-sized sorts per emitted token —
-        # they dominate small-model decode steps
-        tok = jax.lax.cond(temperature > jnp.float32(0.0), sampled, greedy,
-                           (lg, rng))
+        # cond, not where: an all-greedy batch (the bulk of serving
+        # load) must not pay the sampling path's two vocab-sized sorts
+        # per emitted token — they dominate small-model decode steps
+        tok = jax.lax.cond(jnp.any(t_row > jnp.float32(0.0)), sampled,
+                           lambda args: greedy_tok, (lg, keys))
         return tok, _token_logprob(lg, tok)
 
     return select
@@ -680,10 +709,10 @@ def _serve_prefill(model: LlamaModel, params, prompt, length, select, rng,
     cache = prefill_into_cache(cfg, prefill_cache, b, cache_len, 0)
     for entry in cache:
         entry["index"] = length
-    rng, sub = jax.random.split(rng)
-    first, lp0 = select(logits[:, 0, :].astype(jnp.float32), sub)
+    keys, subs = _split_rows(rng)
+    first, lp0 = select(logits[:, 0, :].astype(jnp.float32), subs)
     done0 = (eos_id >= 0) & (first == eos_id)
-    return first, lp0, cache, length, done0, rng
+    return first, lp0, cache, length, done0, keys
 
 
 def _continue_prefill(model: LlamaModel, params, cache, suffix, suffix_len,
@@ -709,10 +738,10 @@ def _continue_prefill(model: LlamaModel, params, cache, suffix, suffix_len,
     start = jnp.broadcast_to(idx + suffix_len, (1,))
     for entry in new_cache:
         entry["index"] = start
-    rng, sub = jax.random.split(rng)
-    first, lp0 = select(logits[:, 0, :].astype(jnp.float32), sub)
+    keys, subs = _split_rows(rng)
+    first, lp0 = select(logits[:, 0, :].astype(jnp.float32), subs)
     done0 = (eos_id >= 0) & (first == eos_id)
-    return first, lp0, new_cache, start, done0, rng
+    return first, lp0, new_cache, start, done0, keys
 
 
 def _next_bucket(n: int, lo: int) -> int:
@@ -906,21 +935,31 @@ class LlamaServer:
 
     # -- AOT snapshot/restore of compiled serving programs -------------------
 
-    @staticmethod
-    def _aot_name(key: tuple) -> str | None:
+    # Serving-program AOT generation: bump when any serving program's
+    # SIGNATURE or carry shape changes, so a pre-change bundle's aot/
+    # dir (which persists across in-place upgrade) orphans its stale
+    # executables instead of loading them. g2 = round 5: per-row knob /
+    # PRNG operands + the (1,)-shaped prefix-continuation carry.
+    _AOT_GEN = "g2"
+
+    @classmethod
+    def aot_prefix(cls) -> str:
+        """Artifact-name prefix for THIS generation's serving programs.
+        The generation tag sits in the prefix so boot-time bulk
+        operations (AotStore.preload) can glob exactly the loadable
+        artifacts — a stale generation's executables must not be
+        device-loaded just to sit unconsumed (code-review r5)."""
+        return f"srv-{cls._AOT_GEN}-"
+
+    @classmethod
+    def _aot_name(cls, key: tuple) -> str | None:
         """Artifact name(s) for a program-cache key; None = not AOT-able."""
         if isinstance(key[0], int):  # fused decode (b, sb, steps)
-            return "srv-dec-" + "-".join(map(str, key))
+            return cls.aot_prefix() + "dec-" + "-".join(map(str, key))
         kind = key[0]
-        if kind == "stream_prefix":
-            # "2": the continuation carry's index/pos went scalar ->
-            # (1,) (ADVICE r4 medium); a pre-fix bundle's aot/ dir may
-            # persist across upgrade, and its stale executable would
-            # re-create the exact carry-shape mismatch the fix removes.
-            # A new name orphans the old artifact instead of loading it.
-            return "srv-stream_prefix2-" + "-".join(map(str, key[1:]))
-        if kind in ("stream", "prefix", "continue", "spec"):
-            return f"srv-{kind}-" + "-".join(map(str, key[1:]))
+        if kind in ("stream", "prefix", "continue", "stream_prefix",
+                    "spec"):
+            return cls.aot_prefix() + f"{kind}-" + "-".join(map(str, key[1:]))
         # "prefix_ext" stays un-AOT-able on purpose: it donates its cache
         # argument, which the store's double-call probe would invalidate
         # between calls — and warmup never compiles it, so there would be
@@ -932,7 +971,9 @@ class LlamaServer:
         the traced shapes of the key's program(s). Returns a list — one
         per callable the key maps to (streaming keys map to a pair)."""
         cfg = self.model.cfg
-        knobs = self._knob_operands(0.0, None, None, 0, None)
+
+        def knobs_for(b):
+            return self._knob_operands(0.0, None, None, 0, None, b=b)
 
         def prompt_ops(b, sb):
             return (jnp.zeros((b, sb), jnp.int32),
@@ -946,11 +987,11 @@ class LlamaServer:
 
         if isinstance(key[0], int):
             b, sb, _steps = key
-            return [(*prompt_ops(b, sb), *knobs)]
+            return [(*prompt_ops(b, sb), *knobs_for(b))]
         kind = key[0]
         if kind == "stream":
             _, b, sb, cache_len, _segment = key
-            t, k, p, rng, eos = knobs
+            t, k, p, rng, eos = knobs_for(b)
             index = jnp.ones((b,), jnp.int32)  # per-row, like the prefill
             cache = init_decode_cache(cfg, b, cache_len)
             for entry in cache:
@@ -961,18 +1002,19 @@ class LlamaServer:
                       cache, index,                  # pos
                       jnp.zeros((b,), jnp.bool_),    # done
                       rng, eos)
-            return [(*prompt_ops(b, sb), *knobs), seg_ex]
+            return [(*prompt_ops(b, sb), t, k, p, rng, eos), seg_ex]
         if kind == "prefix":
             _, sb, _cache_len = key
             return [(jnp.zeros((1, sb), jnp.int32), jnp.int32(1))]
         if kind == "continue":
             _, sbs, _steps, cache_len = key
-            return [(prefix_cache(cache_len),
-                     jnp.zeros((1, sbs), jnp.int32), jnp.int32(1), *knobs)]
+            return [(prefix_cache(cache_len), jnp.zeros((1, sbs), jnp.int32),
+                     jnp.int32(1), *knobs_for(1))]
         if kind == "stream_prefix":
             _, sbs = key
             return [(prefix_cache(cfg.max_len),
-                     jnp.zeros((1, sbs), jnp.int32), jnp.int32(1), *knobs)]
+                     jnp.zeros((1, sbs), jnp.int32), jnp.int32(1),
+                     *knobs_for(1))]
         if kind == "spec":
             # verify inputs are scalar-index (generate_speculative
             # normalizes the prefill carry before the first call)
@@ -1116,14 +1158,43 @@ class LlamaServer:
                 jnp.asarray(lengths + [1] * (bb - len(rows)), jnp.int32))
 
     @staticmethod
-    def _knob_operands(temperature, top_k, top_p, seed, eos_id):
-        """Runtime sampling-knob operands shared by the fused and
-        streaming programs (None = the knob's disabled sentinel)."""
-        return (jnp.float32(temperature if temperature is not None else 0.0),
-                jnp.int32(top_k if top_k is not None else 0),
-                jnp.float32(top_p if top_p is not None else 1.0),
-                jax.random.PRNGKey(seed),
-                jnp.int32(eos_id if eos_id is not None else -1))
+    def _knob_operands(temperature, top_k, top_p, seed, eos_id, b: int = 1):
+        """PER-ROW runtime sampling-knob operands shared by the fused and
+        streaming programs: ``(temperature [b] f32, top_k [b] i32,
+        top_p [b] f32, keys [b, 2] u32, eos [b] i32)``.
+
+        Each knob may be a scalar (broadcast over the b rows; None = the
+        knob's disabled sentinel) or a length-<=b list of per-row values
+        (batcher-fused rows each carrying their own request's knobs;
+        short lists pad with the disabled sentinel for the bucket's
+        dummy rows). Row r's PRNG stream is ``fold_in(PRNGKey(seed_r),
+        0)`` for listed seeds and ``fold_in(PRNGKey(seed), r)`` for one
+        shared seed — a function of the row's own request alone, NEVER
+        of batch composition, so a row samples identically solo or
+        packed next to arbitrary traffic (VERDICT r5 #2)."""
+        import numpy as np
+
+        def vec(x, default, dtype):
+            if isinstance(x, (list, tuple, np.ndarray)):
+                vals = [default if e is None else e for e in x]
+                vals += [default] * (b - len(vals))
+                return jnp.asarray(vals[:b], dtype)
+            return jnp.full((b,), default if x is None else x, dtype)
+
+        if isinstance(seed, (list, tuple, np.ndarray)):
+            seeds = ([int(s) if s is not None else 0 for s in seed]
+                     + [0] * b)[:b]
+            keys = jnp.stack([jax.random.fold_in(jax.random.PRNGKey(s), 0)
+                              for s in seeds])
+        else:
+            base = jax.random.PRNGKey(int(seed) if seed is not None else 0)
+            keys = jax.vmap(lambda r: jax.random.fold_in(base, r))(
+                jnp.arange(b))
+        return (vec(temperature, 0.0, jnp.float32),
+                vec(top_k, 0, jnp.int32),
+                vec(top_p, 1.0, jnp.float32),
+                keys,
+                vec(eos_id, -1, jnp.int32))
 
     def _mesh_ctx(self):
         if self.mesh is None:
@@ -1142,6 +1213,13 @@ class LlamaServer:
         """prompt_tokens: [s], [b, s], or a RAGGED list of rows with
         different lengths (each row decodes from its own prompt end) ->
         [b, max_new_tokens].
+
+        Every sampling knob (``temperature``/``top_k``/``top_p``/
+        ``seed``/``eos_id``) may be a scalar (applies to all rows) or a
+        length-b list of per-row values — the form the batchers use to
+        fuse requests with unrelated knobs into one device call. A
+        row's sampled tokens depend only on its own seed, never on what
+        shares the batch (:meth:`_knob_operands`).
 
         ``prefix``: optional shared-prefix tokens (single-row requests): a
         cached prefill KV for them is reused across requests
@@ -1173,7 +1251,8 @@ class LlamaServer:
         fn = self._compiled(bb, sb, steps)
         prompt_op, length_op = self._pad_rows(rows, lengths, bb, sb)
         args = (self.params, prompt_op, length_op,
-                *self._knob_operands(temperature, top_k, top_p, seed, eos_id))
+                *self._knob_operands(temperature, top_k, top_p, seed,
+                                     eos_id, b=bb))
         with self._mesh_ctx():
             toks, lps = fn(*args)
         toks = np.asarray(jax.device_get(toks))[:b, :max_new_tokens]
@@ -1525,7 +1604,7 @@ class LlamaServer:
         prefill, seg = self._stream_fns(bb, sb, cache_len, segment)
         prompt_op, length_op = self._pad_rows(rows, lengths, bb, sb)
         *knobs, key, eos = self._knob_operands(temperature, top_k, top_p,
-                                               seed, eos_id)
+                                               seed, eos_id, b=bb)
         with self._mesh_ctx():
             carry = prefill(self.params, prompt_op, length_op,
                             *knobs, key, eos)
@@ -1593,6 +1672,126 @@ class LlamaServer:
 
         return self._fn_cached(("spec", kb, cache_len), build)
 
+    def _spec_steps(self, rows, max_new_tokens: int, kb: int, eos_id,
+                    ngram_max: int, stats_out: dict):
+        """The speculative verify loop as a per-step generator: yields
+        ``(tokens, logprobs)`` LISTS per verify step (1..kb tokens each —
+        the accepted draft prefix plus the corrected token), filling
+        ``stats_out`` with the acceptance counters as it goes. Both the
+        fused :meth:`generate_speculative` and the streaming
+        :meth:`generate_speculative_stream` consume this one loop, so
+        their emitted tokens agree by construction."""
+        cfg = self.model.cfg
+        s = len(rows[0])
+        cache_len = cfg.max_len
+        sb = min(_next_bucket(s, self.min_bucket), cache_len)
+        # prefill keyed at the streaming default segment: the prefill
+        # program does not depend on the segment size, so every k (and
+        # the streaming path itself) shares ONE compiled prefill per
+        # bucket instead of compiling a byte-identical copy per k
+        prefill, _ = self._stream_fns(1, sb, cache_len, 16)
+        vf = self._spec_verify_fn(kb, cache_len)
+        prompt_op, length_op = self._pad_rows(rows, [s], 1, sb)
+        knobs = self._knob_operands(0.0, None, None, 0, None)
+        with self._mesh_ctx():
+            tok, lp0, cache, _pos, _done, _rng = prefill(
+                self.params, prompt_op, length_op, *knobs)
+        # normalize the prefill cache's per-row (1,) index to the scalar
+        # the verify fn itself writes: without this the first vf call
+        # traces a second shape variant, doubling the (multi-second
+        # remote) warm compile per ('spec', kb, cache_len) key (ADVICE r4)
+        cache = [{**c, "index": c["index"].reshape(())} for c in cache]
+        pending, pending_lp = (
+            float(x) for x in jax.device_get((tok[0], lp0[0])))
+        pending = int(pending)
+        emitted = 0
+        context = list(map(int, rows[0]))
+        generated: list[int] = []
+        steps = 0
+        while emitted < max_new_tokens:
+            draft = _lookup_draft(context + [pending], kb,
+                                  ngram_max=ngram_max)
+            draft_op = jnp.asarray([draft], jnp.int32)
+            with self._mesh_ctx():
+                chunk, lp_next, count, new_tok, cache = vf(
+                    self.params, draft_op, tok, cache)
+            chunk_h, lp_h, cnt, new_h = jax.device_get(
+                (chunk, lp_next, count, new_tok))
+            cnt = int(cnt)
+            steps += 1
+            toks_step = [int(t) for t in chunk_h[:cnt]]
+            lps_step = [pending_lp] + [float(x) for x in lp_h[:cnt - 1]]
+            emitted += cnt
+            generated.extend(toks_step)
+            pending, pending_lp = int(new_h[0]), float(lp_h[cnt - 1])
+            tok = new_tok
+            context = context[:s] + generated
+            stats_out.update(
+                {"steps": steps, "emitted": emitted,
+                 "tokens_per_step": round(emitted / max(1, steps), 2),
+                 "k": kb})
+            yield toks_step, lps_step
+            if eos_id is not None and eos_id in toks_step:
+                return
+
+    def generate_speculative_stream(self, prompt_tokens, *,
+                                    max_new_tokens: int, k: int = 8,
+                                    eos_id: int | None = None,
+                                    return_logprobs: bool = False,
+                                    ngram_max: int = 3,
+                                    stats_out: dict | None = None):
+        """Streaming speculative decode (VERDICT r5 weak #2 composition):
+        each verify step's ACCEPTED chunk is a stream segment, so
+        time-to-first-token is one prefill plus one verify step — the
+        TTFT-sensitive streamed traffic is exactly where lookup
+        speculation pays most. Yields ``[1, c]`` arrays (1 <= c <= k;
+        ``(tokens, logprobs)`` pairs when asked). Concatenated chunks
+        equal :meth:`generate_speculative`'s output up to and including
+        the first eos (the fused path then pads with eos filler) and are
+        truncated at ``max_new_tokens``. Pass ``stats_out={}`` to
+        receive the acceptance counters (thread-safe, unlike
+        ``spec_stats``)."""
+        import numpy as np
+
+        cfg = self.model.cfg
+        rows, lengths = self._normalize_prompts(prompt_tokens)
+        if len(rows) != 1:
+            raise ValueError("speculative decoding is single-row")
+        s = lengths[0]
+        self._validate(s, max_new_tokens)
+        kb = max(2, _next_bucket(max(2, int(k)), 2))
+        stats = {} if stats_out is None else stats_out
+        if max_new_tokens == 0 or s + max_new_tokens + kb > cfg.max_len:
+            # no room for a full verify chunk near the context boundary:
+            # stream plain decode instead (same fallback as the fused
+            # path, segment-bounded TTFT)
+            stats.update({"fallback": "plain", "steps": max_new_tokens,
+                          "emitted": max_new_tokens,
+                          "tokens_per_step": 1.0, "k": kb})
+            yield from self.generate_stream(
+                rows[0], max_new_tokens=max_new_tokens, eos_id=eos_id,
+                return_logprobs=return_logprobs)
+            return
+        emitted = 0
+        for toks_step, lps_step in self._spec_steps(
+                rows, max_new_tokens, kb, eos_id, ngram_max, stats):
+            take = min(len(toks_step), max_new_tokens - emitted)
+            if take <= 0:
+                return
+            chunk, lp_chunk = toks_step[:take], lps_step[:take]
+            # stop at the row's eos: deliver through it, drop the rest
+            if eos_id is not None and eos_id in chunk:
+                cut = chunk.index(eos_id) + 1
+                chunk, lp_chunk = chunk[:cut], lp_chunk[:cut]
+            emitted += len(chunk)
+            arr = np.asarray([chunk], np.int32)
+            if return_logprobs:
+                yield arr, np.asarray([lp_chunk], np.float32)
+            else:
+                yield arr
+            if eos_id is not None and eos_id in chunk:
+                return
+
     def generate_speculative(self, prompt_tokens, *, max_new_tokens: int,
                              k: int = 8, eos_id: int | None = None,
                              return_logprobs: bool = False,
@@ -1632,53 +1831,13 @@ class LlamaServer:
                      "k": kb}
             self.spec_stats = stats
             return (out, stats) if return_stats else out
-        cache_len = cfg.max_len
-        sb = min(_next_bucket(s, self.min_bucket), cache_len)
-        # prefill keyed at the streaming default segment: the prefill
-        # program does not depend on the segment size, so every k (and
-        # the streaming path itself) shares ONE compiled prefill per
-        # bucket instead of compiling a byte-identical copy per k
-        prefill, _ = self._stream_fns(1, sb, cache_len, 16)
-        vf = self._spec_verify_fn(kb, cache_len)
-        prompt_op, length_op = self._pad_rows(rows, lengths, 1, sb)
-        knobs = self._knob_operands(0.0, None, None, 0, None)
-        with self._mesh_ctx():
-            tok, lp0, cache, _pos, _done, _rng = prefill(
-                self.params, prompt_op, length_op, *knobs)
-        # normalize the prefill cache's per-row (1,) index to the scalar
-        # the verify fn itself writes: without this the first vf call
-        # traces a second shape variant, doubling the (multi-second
-        # remote) warm compile per ('spec', kb, cache_len) key (ADVICE r4)
-        cache = [{**c, "index": c["index"].reshape(())} for c in cache]
-        pending, pending_lp = (
-            float(x) for x in jax.device_get((tok[0], lp0[0])))
-        pending = int(pending)
         emitted: list[int] = []
         lps: list[float] = []
-        context = list(map(int, rows[0]))
-        steps = 0
-        while len(emitted) < max_new_tokens:
-            draft = _lookup_draft(context + [pending], kb,
-                                  ngram_max=ngram_max)
-            draft_op = jnp.asarray([draft], jnp.int32)
-            with self._mesh_ctx():
-                chunk, lp_next, count, new_tok, cache = vf(
-                    self.params, draft_op, tok, cache)
-            chunk_h, lp_h, cnt, new_h = jax.device_get(
-                (chunk, lp_next, count, new_tok))
-            cnt = int(cnt)
-            steps += 1
-            emitted.extend(int(t) for t in chunk_h[:cnt])
-            lps.append(pending_lp)
-            lps.extend(float(x) for x in lp_h[:cnt - 1])
-            pending, pending_lp = int(new_h[0]), float(lp_h[cnt - 1])
-            tok = new_tok
-            context = context[:len(rows[0])] + emitted
-            if eos_id is not None and eos_id in chunk_h[:cnt]:
-                break
-        stats = {"steps": steps, "emitted": len(emitted),
-                 "tokens_per_step": round(
-                     len(emitted) / max(1, steps), 2), "k": kb}
+        stats: dict = {}
+        for toks_step, lps_step in self._spec_steps(
+                rows, max_new_tokens, kb, eos_id, ngram_max, stats):
+            emitted.extend(toks_step)
+            lps.extend(lps_step)
         # kept as a convenience for single-threaded callers/tests; the
         # thread-safe channel is return_stats (a threaded server must not
         # read another request's counters)
@@ -1728,12 +1887,15 @@ def _decode(model: LlamaModel, params, prompt_tokens, *, max_new_tokens: int,
         params, prompt_tokens,
         logit_positions=jnp.full((b,), s - 1, jnp.int32))
     cache = prefill_into_cache(cfg, prefill_cache, b, max_len, s)
-    rng, sub = jax.random.split(rng)
-    first_token, lp0 = select_fn(logits[:, -1, :].astype(jnp.float32), sub)
+    # per-row PRNG chains (row r = fold_in of the caller's key), the same
+    # scheme the serving path uses (_knob_operands)
+    keys = jax.vmap(lambda r: jax.random.fold_in(rng, r))(jnp.arange(b))
+    keys, subs = _split_rows(keys)
+    first_token, lp0 = select_fn(logits[:, -1, :].astype(jnp.float32), subs)
     eos = jnp.int32(-1 if eos_id is None else eos_id)
     done0 = (eos >= 0) & (first_token == eos)
     toks, _ = _scan_decode(model, params, select_fn, first_token, lp0, cache,
-                           jnp.int32(s), done0, rng, eos, max_new_tokens)
+                           jnp.int32(s), done0, keys, eos, max_new_tokens)
     return toks
 
 
@@ -1763,10 +1925,10 @@ def sample_generate(model: LlamaModel, params, prompt_tokens, *, rng,
                                max_new_tokens=max_new_tokens, max_len=max_len,
                                eos_id=eos_id)
 
-    def select(logits, rng):
+    def select(logits, keys):
         filt = filter_logits(logits / jnp.float32(temperature),
                              top_k=top_k, top_p=top_p)
-        tok = jax.random.categorical(rng, filt, axis=-1).astype(jnp.int32)
+        tok = jax.vmap(jax.random.categorical)(keys, filt).astype(jnp.int32)
         return tok, _token_logprob(logits, tok)
 
     return _decode(model, params, prompt_tokens, max_new_tokens=max_new_tokens,

@@ -88,6 +88,10 @@ class AotStore:
         # set when a matching meta existed but produced no usable tier —
         # the signal that re-saving would just reproduce the same artifacts
         self.exhausted = False
+        # artifacts deserialized ahead of time by preload(): name ->
+        # (callable, tier). load() consumes these instead of re-reading
+        # the tier file, and still probes them.
+        self._preloaded: dict[str, tuple] = {}
 
     def _mesh_ctx(self):
         """Trace/compile/probe under the payload mesh (models read it for
@@ -289,6 +293,53 @@ class AotStore:
         probe operands for artifacts that were never saved."""
         return self._paths(name)["meta"].is_file()
 
+    def preload(self, prefix: str = "srv-") -> dict:
+        """Deserialize (and device-load) every matching artifact's best
+        tier WITHOUT probing. Executable deserialization + the remote
+        program load need NO operands — the model weights don't have to
+        be resident — so a boot overlaps this with the weight upload
+        instead of paying programs-after-weights serially (VERDICT r5
+        #5: at 8B through the tunnel the two phases were 54.6 s + 220 s
+        back to back). ``load()`` later consumes the preloaded callable
+        and runs its usual probe at first invoke, when params exist.
+
+        Returns ``{"names": [...], "seconds": s}`` for the boot
+        decomposition. Failures are per-artifact and silent — a broken
+        artifact just falls back to load()'s normal path."""
+        import jax
+
+        t0 = time.monotonic()
+        out: list[str] = []
+        if not self.dir.is_dir():
+            return {"names": out, "seconds": 0.0}
+        sig = _mesh_sig(self.mesh)
+        suffix = f".{jax.default_backend()}" + (f".{sig}" if sig else "")
+        env = _env_key(self.mesh)
+        for meta_path in sorted(self.dir.glob(f"{prefix}*{suffix}.json")):
+            name = meta_path.name[: -len(suffix + ".json")]
+            try:
+                meta = json.loads(meta_path.read_text())
+            except Exception:
+                continue
+            if any(meta.get(k) != env[k]
+                   for k in ("schema", "platform", "jax", "jaxlib",
+                             "n_devices", "mesh")):
+                continue
+            paths = self._paths(name)
+            for tier in ("exec", "hlo"):
+                if tier not in meta.get("tiers", ()):
+                    continue
+                try:
+                    with self._mesh_ctx():
+                        fn = self._load_tier(tier, paths)
+                except Exception:
+                    continue
+                if fn is not None:
+                    self._preloaded[name] = (fn, tier)
+                    out.append(name)
+                    break
+        return {"names": out, "seconds": round(time.monotonic() - t0, 3)}
+
     def _load_tier(self, tier: str, paths: dict):
         """Deserialize one tier into a callable (no probing/gating)."""
         import jax
@@ -371,8 +422,21 @@ class AotStore:
                          first_ms, ms)
             return True
 
+        pre = self._preloaded.pop(name, None)
+        tried = None
+        if pre is not None and pre[1] in meta.get("tiers", ()):
+            # deserialized ahead of time (preload(), overlapped with the
+            # weight upload); only the probe remains
+            fn, tried = pre
+            try:
+                with self._mesh_ctx():
+                    if _probe(fn, tried):
+                        return fn, tried
+            except Exception as e:
+                log.warning("aot %s: preloaded %s tier failed probe: %s",
+                            name, tried, e)
         for tier in ("exec", "hlo"):
-            if tier not in meta.get("tiers", ()):
+            if tier == tried or tier not in meta.get("tiers", ()):
                 continue
             try:
                 with self._mesh_ctx():

@@ -3,25 +3,27 @@
 The HTTP server is threaded; under concurrent load, each request was
 dispatched to the device alone. The decode path supports RAGGED batches
 (per-row length operands, models/llama.py LlamaServer), so concurrent
-requests with the same sampling knobs can share one device program:
-batch-1 decode is HBM-bandwidth-bound on TPU (every step re-reads all
-weights), so b rows decode in nearly the time of one — near-linear
-throughput until the MXU saturates.
+requests can share one device program: batch-1 decode is
+HBM-bandwidth-bound on TPU (every step re-reads all weights), so b rows
+decode in nearly the time of one — near-linear throughput until the MXU
+saturates.
 
 Protocol: the first thread to arrive becomes the leader, sleeps one
 collection window while followers queue, then drains every compatible
-pending request with ITS knob key (temperature/top-k/p/seed/eos must
-match — they are shared operands of the fused call) into one ragged
-``server.generate``. After every batch the condition variable wakes all
-waiters: finished requests return, and the current queue head's own
-thread drains the next group — each thread serves at most the batches
-its own request rides on, so no thread is conscripted into serving the
-queue forever, and no key composition can strand a request. Greedy
-results are bitwise identical to solo serving (per-row parity is
-tested). Sampled (temperature > 0) requests bypass the queue and run
-solo: a fused categorical draws per row index, which would make a
-request's tokens depend on concurrent traffic and break what ``seed``
-promises.
+pending request into one ragged ``server.generate``. After every batch
+the condition variable wakes all waiters: finished requests return, and
+the current queue head's own thread drains the next group — each thread
+serves at most the batches its own request rides on, so no thread is
+conscripted into serving the queue forever, and no composition can
+strand a request.
+
+EVERY request shape fuses (VERDICT r5 #2): the sampling knobs
+(temperature/top-k/p/eos) are per-row operands of the fused call, and
+each row's PRNG chain derives from its own seed alone
+(llama._knob_operands), so a row's output — greedy or sampled — is
+bitwise identical to serving it solo. ``seed`` keeps its
+reproducibility promise under arbitrary concurrent traffic; per-row
+parity is tested for both.
 
 Opt-in per bundle: ``[payload.extra] batch_window_ms = 2`` (0 = off).
 """
@@ -52,10 +54,10 @@ class MicroBatcher:
 
     # -- internals ----------------------------------------------------------
 
-    def _drain_locked(self, key) -> list[dict]:
-        """Take pending same-key entries that can legally FUSE: the fused
-        call pays max(prompt len) + max(max_new) and the shared decode
-        cap, so an entry valid solo may be incompatible with the forming
+    def _drain_locked(self) -> list[dict]:
+        """Take pending entries that can legally FUSE: the fused call
+        pays max(prompt len) + max(max_new) and the shared decode cap,
+        so an entry valid solo may be incompatible with the forming
         batch — it stays queued for a later batch rather than poisoning
         this one. The head entry is always taken, alone if need be, so
         its own (possibly invalid) request errors only to its caller."""
@@ -64,8 +66,8 @@ class MicroBatcher:
         batch: list[dict] = []
         s_max = n_max = 0
         for e in list(self._pending):
-            if len(batch) >= self.max_batch or e["key"] != key:
-                continue
+            if len(batch) >= self.max_batch:
+                break
             s = max(s_max, len(e["row"]))
             n = max(n_max, e["n"])
             if batch and (s + n > max_len or n > cap):
@@ -78,15 +80,22 @@ class MicroBatcher:
     def _run_one(self, batch: list[dict]) -> None:
         if not batch:
             return
-        temperature, top_k, top_p, seed, eos_id = batch[0]["key"]
         try:
             n = max(e["n"] for e in batch)
+            want_lp = any(e["want_lp"] for e in batch)
             out = self.server.generate(
                 [e["row"] for e in batch], max_new_tokens=n,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                seed=seed, eos_id=eos_id)
+                temperature=[e["temperature"] for e in batch],
+                top_k=[e["top_k"] for e in batch],
+                top_p=[e["top_p"] for e in batch],
+                seed=[e["seed"] for e in batch],
+                eos_id=[e["eos_id"] for e in batch],
+                return_logprobs=want_lp)
+            toks, lps = out if want_lp else (out, None)
             for i, e in enumerate(batch):
-                e["result"] = out[i : i + 1, : e["n"]]
+                e["result"] = toks[i : i + 1, : e["n"]]
+                if lps is not None:
+                    e["lps"] = lps[i : i + 1, : e["n"]]
         except Exception as ex:  # surfaces per-request, server stays up
             for e in batch:
                 e["error"] = ex
@@ -97,52 +106,42 @@ class MicroBatcher:
                 e["done"] = True
             self._cond.notify_all()
 
-    def _serve_group(self, key) -> None:
+    def _serve_group(self) -> None:
         with self._cond:
-            batch = self._drain_locked(key)
+            batch = self._drain_locked()
         self._run_one(batch)
 
     # -- API ----------------------------------------------------------------
 
     def generate(self, prompt_row, *, max_new_tokens: int,
                  temperature: float = 0.0, top_k=None, top_p=None,
-                 seed: int = 0, eos_id=None):
+                 seed: int = 0, eos_id=None, return_logprobs: bool = False):
         """One request row -> [1, max_new_tokens] (same contract as
-        ``server.generate`` on a single prompt)."""
-        # sampled requests run solo: a fused categorical draws per ROW
-        # INDEX from the shared key, so a row's tokens would depend on
-        # uncontrollable concurrent traffic and `seed` would silently stop
-        # meaning reproducibility. Greedy (the bulk of batchable serving
-        # load) is row-exact under fusion.
-        if self.window_s <= 0.0 or (temperature or 0.0) > 0.0:
+        ``server.generate`` on a single prompt, logprobs included)."""
+        if self.window_s <= 0.0:
             return self.server.generate(
                 prompt_row, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                seed=seed, eos_id=eos_id)
+                seed=seed, eos_id=eos_id, return_logprobs=return_logprobs)
 
-        # greedy decode is argmax: temperature (<= 0), top_k/top_p and seed
-        # are provably inert (llama._serve_decode select()), so normalize
-        # them out of the fuse key — clients that send a per-request seed
-        # with temperature=0 (a common pattern) must still batch together.
-        # eos_id stays: it is a live shared operand of the fused call.
-        key = (0.0, None, None, 0, eos_id)
-        entry = {"row": prompt_row, "n": max_new_tokens, "key": key,
+        entry = {"row": prompt_row, "n": max_new_tokens,
+                 "temperature": temperature, "top_k": top_k, "top_p": top_p,
+                 "seed": seed, "eos_id": eos_id,
+                 "want_lp": return_logprobs, "lps": None,
                  "done": False, "result": None, "error": None}
         with self._cond:
             self._pending.append(entry)
             leader = len(self._pending) == 1
             self._cond.notify_all()  # a collecting leader may now be full
         if leader:
-            # collect for one window, waking early once no more same-key
-            # entries can fit anyway
+            # collect for one window, waking early once full anyway
             deadline = time.monotonic() + self.window_s
             with self._cond:
                 while (remaining := deadline - time.monotonic()) > 0:
-                    same = sum(1 for e in self._pending if e["key"] == key)
-                    if same >= self.max_batch:
+                    if len(self._pending) >= self.max_batch:
                         break
                     self._cond.wait(timeout=remaining)
-            self._serve_group(key)
+            self._serve_group()
         while True:
             with self._cond:
                 if entry["done"]:
@@ -152,12 +151,14 @@ class MicroBatcher:
                     # still collecting); the post-batch notify wakes us
                     self._cond.wait(timeout=1.0)
                     continue
-            # we are the queue head: serve our own key group now instead
-            # of waiting out a timeout (covers leader-overflow leftovers
-            # and key groups the previous batch didn't match)
-            self._serve_group(key)
+            # we are the queue head: serve our own group now instead of
+            # waiting out a timeout (covers leader-overflow leftovers and
+            # entries the previous batch couldn't legally fuse)
+            self._serve_group()
         if entry["error"] is not None:
             raise entry["error"]
+        if return_logprobs:
+            return entry["result"], entry["lps"]
         return entry["result"]
 
     def stats(self) -> dict:

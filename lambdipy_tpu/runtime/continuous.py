@@ -36,10 +36,12 @@ Design (all device work rides LlamaServer's compiled-program cache):
   eos exactly like the fused path's filler. This removes eos from any
   fuse key — rows with different eos ids share the batch — at the cost
   of at most one wasted segment per early-stopping row.
-- Sampled requests (temperature > 0) bypass the engine and run solo,
-  same reasoning as the MicroBatcher: a fused categorical draws by row
-  index, so a row's sample would depend on concurrent traffic and break
-  what ``seed`` promises. Greedy is the batchable bulk of serving load.
+- SAMPLED rows batch too (VERDICT r5 #2): the segment program's
+  sampling knobs are per-row operands and each row's PRNG chain derives
+  from its own seed alone (llama._knob_operands), so a sampled row's
+  tokens are identical solo or packed — ``seed`` keeps its
+  reproducibility promise under arbitrary concurrent traffic. The
+  per-slot knob vectors are assembled host-side before each segment.
 
 Opt-in per bundle: ``[payload.extra] batch_mode = "continuous"``
 (default keeps the window MicroBatcher when ``batch_window_ms`` is set).
@@ -54,8 +56,6 @@ from lambdipy_tpu.utils.logs import get_logger
 
 log = get_logger("lambdipy.continuous")
 
-_GREEDY = dict(temperature=0.0, top_k=None, top_p=None)
-
 
 class ContinuousBatcher:
     """Segment-boundary continuous batching over a LlamaServer."""
@@ -69,13 +69,13 @@ class ContinuousBatcher:
         self.slots = max(1, slots)
         self.segment = max(1, segment)
         self.cache_len = min(cache_len or cfg.max_len, cfg.max_len)
+        del jax  # imported for device presence; carry is built lazily
         self._lock = threading.Condition()
         self._joiners: list[dict] = []   # prefilled rows awaiting a slot
         self._active: list[dict | None] = [None] * self.slots
         self._engine_running = False
         self._carry = None               # lazily built B-slot device carry
         self._pack_fn = None
-        self._rng = jax.random.PRNGKey(0)
         # observability (stats()): how much fusing actually happened
         self.segments_run = 0
         self.rows_in_segments = 0
@@ -99,7 +99,7 @@ class ContinuousBatcher:
                 cache,
                 jnp.zeros((b,), jnp.int32),      # pos
                 jnp.zeros((b,), jnp.bool_),      # done (never latches)
-                self._rng)
+                jnp.zeros((b, 2), jnp.uint32))   # per-row PRNG keys
 
     def _pack(self, carry, row_carry, slot: int):
         """Write the 1-row carry into batch slot ``slot`` (one compiled
@@ -112,34 +112,37 @@ class ContinuousBatcher:
                     return jax.lax.dynamic_update_slice_in_dim(
                         b_leaf, r_leaf.astype(b_leaf.dtype), slot, 0)
 
-                tok, lp, cache, pos, done, rng = batch_carry
-                rtok, rlp, rcache, rpos, rdone, _ = row_carry
+                tok, lp, cache, pos, done, keys = batch_carry
+                rtok, rlp, rcache, rpos, rdone, rkeys = row_carry
                 new_cache = [{k: upd(c[k], rc[k]) for k in c}
                              for c, rc in zip(cache, rcache)]
+                # the row's PRNG chain packs too: its post-prefill key
+                # continues exactly where solo decode would be
                 return (upd(tok, rtok), upd(lp, rlp), new_cache,
-                        upd(pos, rpos), upd(done, rdone), rng)
+                        upd(pos, rpos), upd(done, rdone), upd(keys, rkeys))
 
             self._pack_fn = jax.jit(pack)
         import jax.numpy as jnp
 
         return self._pack_fn(carry, row_carry, jnp.int32(slot))
 
-    def _prefill_row(self, row, s: int):
+    def _prefill_row(self, row, s: int, entry: dict):
         """Single-row bucketed prefill -> 1-row carry over the engine's
         cache_len (reuses the streaming prefill program family, so a
         joiner costs one prefill compile per prompt bucket, shared with
-        the streaming path)."""
-        import jax.numpy as jnp
-
+        the streaming path). The row's OWN sampling knobs and seed drive
+        the first-token select, so the carry continues exactly the
+        chain solo decode would walk; eos stays disabled (host-side)."""
         from lambdipy_tpu.models.llama import _next_bucket
 
         server = self.server
-        cfg = server.model.cfg
         sb = max(s, min(_next_bucket(s, server.min_bucket),
                         self.cache_len))
         prefill, _ = server._stream_fns(1, sb, self.cache_len, self.segment)
         prompt_op, length_op = server._pad_rows([row], [s], 1, sb)
-        knobs = server._knob_operands(eos_id=None, seed=0, **_GREEDY)
+        knobs = server._knob_operands(
+            entry["temperature"], entry["top_k"], entry["top_p"],
+            entry["seed"], None, b=1)
         with server._mesh_ctx():
             return prefill(server.params, prompt_op, length_op, *knobs)
 
@@ -169,12 +172,15 @@ class ContinuousBatcher:
 
     def _engine_body(self):
         import jax
+        import jax.numpy as jnp
         import numpy as np
 
         server = self.server
         seg = self._segment_fn()
-        t_op, k_op, p_op, _, eos_op = server._knob_operands(
-            eos_id=-1, seed=0, **_GREEDY)  # eos handled host-side
+        # eos stays disabled on device (host-side truncation); the
+        # sampling knobs are PER-SLOT vectors rebuilt before each
+        # segment from the active rows' own requests
+        eos_op = jnp.full((self.slots,), -1, jnp.int32)
         while True:
             with self._lock:
                 free = [i for i, a in enumerate(self._active) if a is None]
@@ -196,9 +202,21 @@ class ContinuousBatcher:
                                          joiner["slot"])
                 joiner["carry"] = None  # free the 1-row cache
                 joiner["packed"] = True
+            with self._lock:
+                t_host = np.zeros((self.slots,), np.float32)
+                k_host = np.zeros((self.slots,), np.int32)
+                p_host = np.ones((self.slots,), np.float32)
+                for slot, e in enumerate(self._active):
+                    if e is not None:
+                        t_host[slot] = e["temperature"] or 0.0
+                        k_host[slot] = e["top_k"] or 0
+                        p_host[slot] = (1.0 if e["top_p"] is None
+                                        else e["top_p"])
             with server._mesh_ctx():
                 (toks, lps), self._carry = seg(
-                    server.params, t_op, k_op, p_op, *self._carry, eos_op)
+                    server.params, jnp.asarray(t_host),
+                    jnp.asarray(k_host), jnp.asarray(p_host),
+                    *self._carry, eos_op)
             # one host fetch per segment: on a remote-tunnel transport
             # every device_get of a fresh result pays one RTT (~66 ms
             # measured), so the logprob block rides the same fetch — and
@@ -228,48 +246,100 @@ class ContinuousBatcher:
                         self.requests_served += 1
                 self._lock.notify_all()
 
+    def _prefill_prefix_row(self, prefix_tokens, row, s: int, entry: dict):
+        """Continue-prefill from a cached prefix KV -> 1-row carry over
+        the FULL context window (the prefix cache's size). The same
+        continuation program streaming-with-prefix uses, so packing a
+        prefix row into the engine adds zero new program families."""
+        import jax.numpy as jnp
+
+        from lambdipy_tpu.models.llama import _next_bucket
+
+        server = self.server
+        cfg = server.model.cfg
+        cache, plen = server._prefix_entry(prefix_tokens)
+        server._validate(plen + s, entry["n"])
+        sbs = min(_next_bucket(s, server.min_bucket), cfg.max_len - plen)
+        cont = server._stream_prefix_fn(sbs)
+        suffix_op, _ = server._pad_rows([row], [s], 1, sbs)
+        knobs = server._knob_operands(
+            entry["temperature"], entry["top_k"], entry["top_p"],
+            entry["seed"], None, b=1)
+        with server._mesh_ctx():
+            return cont(server.params, cache, suffix_op, jnp.int32(s),
+                        *knobs)
+
     # -- API -----------------------------------------------------------------
 
-    def generate(self, prompt_row, *, max_new_tokens: int,
-                 temperature: float = 0.0, top_k=None, top_p=None,
-                 seed: int = 0, eos_id=None, return_logprobs: bool = False):
-        """One request row -> [1, max_new_tokens] (the ``server.generate``
-        single-prompt contract, logprobs included)."""
+    def _admit(self, prompt_row, max_new_tokens, temperature, top_k, top_p,
+               seed, eos_id, return_logprobs, prefix):
+        """Shared admission: validate, prefill (plain or from a cached
+        prefix), enqueue as a joiner and start the engine. Returns the
+        live entry dict, or None when the request must run solo (over
+        the engine's cache cap, or a prefix row when the engine cache is
+        smaller than the prefix cache's full window)."""
         import numpy as np
 
-        if (temperature or 0.0) > 0.0 or max_new_tokens <= 0:
-            return self.server.generate(
-                prompt_row, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                seed=seed, eos_id=eos_id, return_logprobs=return_logprobs)
+        if max_new_tokens <= 0:
+            return None
         row = np.asarray(prompt_row, np.int32).reshape(-1).tolist()
         s = len(row)
-        if s + max_new_tokens > self.cache_len:
-            # a request over the engine's (operator-capped) cache_len is
-            # still servable solo — the same bundle served it before
-            # continuous mode existed, so don't turn the cap into a
-            # client-visible error (ADVICE r4); server._validate still
-            # rejects what the model itself can't hold
-            return self.server.generate(
-                row, max_new_tokens=max_new_tokens,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                seed=seed, eos_id=eos_id, return_logprobs=return_logprobs)
-        self.server._validate(s, max_new_tokens)
-
-        # prefill alone; the engine's segments emit the tokens (the scan
-        # re-emits the carry's first token, so everything flows from the
-        # segment outputs — nothing is delivered eagerly)
-        row_carry = self._prefill_row(row, s)
-        entry = {"carry": row_carry, "n": max_new_tokens,
-                 "eos_id": eos_id, "toks": [], "lps": [],
+        entry = {"n": max_new_tokens, "eos_id": eos_id,
+                 "temperature": temperature, "top_k": top_k, "top_p": top_p,
+                 "seed": seed, "toks": [], "lps": [],
                  "want_lp": return_logprobs,
                  "done": False, "error": None, "slot": None, "packed": False}
+        if prefix is not None:
+            # a prefix carry's cache is sized to the full context window
+            # (LlamaServer.cache_prefix); it can only pack into an
+            # engine whose slots are that size
+            if self.cache_len != self.server.model.cfg.max_len:
+                return None
+            entry["carry"] = self._prefill_prefix_row(prefix, row, s, entry)
+        else:
+            if s + max_new_tokens > self.cache_len:
+                # a request over the engine's (operator-capped)
+                # cache_len is still servable solo — the same bundle
+                # served it before continuous mode existed, so don't
+                # turn the cap into a client-visible error (ADVICE r4);
+                # server._validate still rejects what the model itself
+                # can't hold
+                return None
+            self.server._validate(s, max_new_tokens)
+            # prefill alone under the row's own knobs; the engine's
+            # segments emit the tokens (the scan re-emits the carry's
+            # first token, so everything flows from the segment outputs
+            # — nothing is delivered eagerly)
+            entry["carry"] = self._prefill_row(row, s, entry)
         with self._lock:
             self._joiners.append(entry)
             if not self._engine_running:
                 self._engine_running = True
                 threading.Thread(target=self._engine_loop, daemon=True,
                                  name="continuous-batch").start()
+        return entry
+
+    def generate(self, prompt_row, *, max_new_tokens: int,
+                 temperature: float = 0.0, top_k=None, top_p=None,
+                 seed: int = 0, eos_id=None, prefix=None,
+                 return_logprobs: bool = False):
+        """One request row -> [1, max_new_tokens] (the ``server.generate``
+        single-prompt contract, logprobs included). Sampled requests
+        batch like greedy ones — per-row knob operands and seed-derived
+        per-row PRNG chains make a row's output independent of what
+        shares the engine (VERDICT r5 #2) — and ``prefix=`` rows join
+        the shared batch from their cached prefix KV (VERDICT r5 #3c)."""
+        import numpy as np
+
+        entry = self._admit(prompt_row, max_new_tokens, temperature, top_k,
+                            top_p, seed, eos_id, return_logprobs, prefix)
+        if entry is None:
+            return self.server.generate(
+                prompt_row, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, eos_id=eos_id, prefix=prefix,
+                return_logprobs=return_logprobs)
+        with self._lock:
             while not entry["done"]:
                 self._lock.wait(timeout=1.0)
         if entry["error"] is not None:
@@ -285,6 +355,67 @@ class ContinuousBatcher:
         if return_logprobs:
             return out, np.asarray([lps[:max_new_tokens]], np.float32)
         return out
+
+    def generate_stream(self, prompt_row, *, max_new_tokens: int,
+                        temperature: float = 0.0, top_k=None, top_p=None,
+                        seed: int = 0, eos_id=None, segment: int = 16,
+                        prefix=None, return_logprobs: bool = False):
+        """Streaming over the SHARED engine batch (VERDICT r5 #3b): the
+        row joins in-flight decode like any other request and its slice
+        of each segment is yielded as it lands — segment-boundary
+        delivery IS a stream, so streamed requests no longer bypass
+        continuous batching. Yields ``[1, k]`` chunks ((tokens,
+        logprobs) pairs when asked); concatenated chunks equal the
+        non-streamed ``generate`` output up to the segment containing
+        eos, exactly like ``LlamaServer.generate_stream``. The chunk
+        cadence is the ENGINE's segment size (the per-request
+        ``segment`` knob applies only to the solo fallback)."""
+        import numpy as np
+
+        entry = self._admit(prompt_row, max_new_tokens, temperature, top_k,
+                            top_p, seed, eos_id, return_logprobs, prefix)
+        if entry is None:
+            yield from self.server.generate_stream(
+                prompt_row, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, eos_id=eos_id, segment=segment, prefix=prefix,
+                return_logprobs=return_logprobs)
+            return
+        delivered = 0
+        latched = False
+        while not latched:
+            with self._lock:
+                while (not entry["done"]
+                       and len(entry["toks"]) <= delivered):
+                    self._lock.wait(timeout=1.0)
+                if entry["error"] is not None:
+                    raise entry["error"]
+                if entry["done"] and len(entry["toks"]) <= delivered:
+                    return
+                toks = list(entry["toks"])
+                lps = list(entry["lps"])
+            take = min(len(toks), max_new_tokens)
+            chunk = toks[delivered:take]
+            lp_chunk = lps[delivered:take] if entry["want_lp"] else None
+            if not chunk:
+                return
+            # eos latch parity with the fused path: fill the rest of
+            # the delivering chunk with eos (the device latch would
+            # have), then stop the stream at this segment boundary
+            if eos_id is not None and eos_id in chunk:
+                cut = chunk.index(eos_id) + 1
+                chunk = chunk[:cut] + [eos_id] * (len(chunk) - cut)
+                if lp_chunk is not None:
+                    lp_chunk = lp_chunk[:cut] + [0.0] * (len(chunk) - cut)
+                latched = True
+            delivered = take
+            arr = np.asarray([chunk], np.int32)
+            if entry["want_lp"]:
+                yield arr, np.asarray([lp_chunk], np.float32)
+            else:
+                yield arr
+            if delivered >= max_new_tokens:
+                return
 
     def stats(self) -> dict:
         with self._lock:

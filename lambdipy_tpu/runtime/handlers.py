@@ -274,18 +274,56 @@ def text_classify_handler(spec: dict, ctx) -> HandlerState:
 def generate_handler(spec: dict, ctx) -> HandlerState:
     """Config 5: Llama TP int8 generation (greedy by default; requests may
     set temperature / top_k / top_p / seed / eos_id for sampled decode)."""
+    import threading as _threading
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    extra = spec.get("extra") or {}
+    # Cold-start overlap (VERDICT r5 #5): AOT executable deserialization
+    # + remote program loads need no weights, and the bulk weight upload
+    # needs no programs — run them CONCURRENTLY instead of serially (at
+    # 8B through the tunnel the serial order was 54.6 s weights THEN
+    # ~220 s programs). The store is created before the params load and
+    # its preload thread joins right after; LlamaServer then consumes
+    # the preloaded executables with only the probe left to pay.
+    serve_aot_store = None
+    preload_state: dict = {}
+    preload_thread = None
+    single_spec = not any(v > 1 for v in (spec.get("mesh") or {}).values())
+    if single_spec and getattr(ctx, "bundle_dir", None) is not None \
+            and str(extra.get("serve_aot", "1")) != "0":
+        from lambdipy_tpu.runtime.aot import AotStore
+
+        # gate sized for decode programs (an honest 8B 64-token decode
+        # call is ~700 ms — the default 500 ms forward-program gate
+        # would reject it as "slow")
+        serve_aot_store = AotStore(
+            ctx.bundle_dir,
+            gate_ms=float(extra.get("serve_aot_gate_ms", 30000)))
+        # preload only the CURRENT generation's artifacts: an upgraded
+        # bundle's aot/ dir keeps the previous generation's orphans,
+        # and device-loading those would pay the very remote program
+        # loads this overlap hides, for executables load() never reads
+        from lambdipy_tpu.models.llama import LlamaServer as _LS
+
+        preload_thread = _threading.Thread(
+            target=lambda: preload_state.update(
+                serve_aot_store.preload(prefix=_LS.aot_prefix())),
+            daemon=True, name="aot-preload")
+        preload_thread.start()
+
     adapter, params = _jax_adapter_and_params(spec, ctx)
     params, mesh = _maybe_shard(adapter, params, spec)
-    extra = spec.get("extra") or {}
+    if preload_thread is not None:
+        preload_thread.join()
     default_new = int(extra.get("max_new_tokens", 16))
     # compile-once serving: prompt-length bucketing + runtime sampling
     # knobs, one compiled program per shape bucket (llama.LlamaServer)
     server = None
     batcher = None
+    continuous = None  # set when batcher is the ContinuousBatcher
     if adapter.make_server is not None:
         cap = extra.get("decode_cap")  # None = full context window
         server_caps = {"decode_cap": int(cap) if cap else None}
@@ -306,14 +344,17 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 and str(extra.get("serve_aot", "1")) != "0":
             # serving programs ride the bundle's AOT exec tier: at real
             # scale each is a ~70 s remote compile, and a loaded
-            # executable boots in seconds. Gate sized for decode programs
-            # (an honest 8B 64-token decode call is ~700 ms — the default
-            # 500 ms forward-program gate would reject it as "slow").
-            from lambdipy_tpu.runtime.aot import AotStore
+            # executable boots in seconds. Normally the store was built
+            # above (its preload overlapped the weight upload); the
+            # degraded case (spec asked for a mesh this host can't
+            # provide) builds it here without preload.
+            if serve_aot_store is None:
+                from lambdipy_tpu.runtime.aot import AotStore
 
-            server_caps["aot"] = AotStore(
-                ctx.bundle_dir,
-                gate_ms=float(extra.get("serve_aot_gate_ms", 30000)))
+                serve_aot_store = AotStore(
+                    ctx.bundle_dir,
+                    gate_ms=float(extra.get("serve_aot_gate_ms", 30000)))
+            server_caps["aot"] = serve_aot_store
         server = adapter.make_server(params, mesh=mesh, **server_caps)
         window_ms = float(extra.get("batch_window_ms", 0) or 0)
         batch_mode = str(extra.get("batch_mode", "") or "").lower()
@@ -325,7 +366,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # window caches otherwise — at 8B dims that is HBM that the
             # operator must be able to cap per bundle)
             bcl = extra.get("batch_cache_len")
-            batcher = ContinuousBatcher(
+            batcher = continuous = ContinuousBatcher(
                 server, slots=int(extra.get("batch_max", 8)),
                 segment=int(extra.get("batch_segment", 16)),
                 cache_len=int(bcl) if bcl else None)
@@ -408,16 +449,18 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         except Exception as e:  # noqa: BLE001 - degrade, recorded in meta
             tok_err = str(e)
 
-    def run(prompt, max_new, sample_kwargs):
+    def run(prompt, max_new, sample_kwargs, want_lp=False):
         # prompt stays a host numpy array until the chosen path needs it:
         # the server/batcher convert internally, only the legacy
-        # adapter.generate path pays a device transfer here
+        # adapter.generate path pays a device transfer here. logprob
+        # requests ride the batchers like any other (the fused program
+        # computes logprobs anyway; want_lp only adds a fetch).
         if batcher is not None and len(prompt) == 1:
             return batcher.generate(prompt[0], max_new_tokens=max_new,
-                                    **sample_kwargs)
+                                    return_logprobs=want_lp, **sample_kwargs)
         if server is not None:
             return server.generate(prompt, max_new_tokens=max_new,
-                                   **sample_kwargs)
+                                   return_logprobs=want_lp, **sample_kwargs)
         device_prompt = jnp.asarray(prompt)
         if mesh is not None:
             with mesh:
@@ -583,19 +626,25 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 return_stats=True)
             toks, lps = out_ if want_lp else (out_, None)
         elif prefix is not None:
-            # shared-prefix KV reuse: only the suffix prefills per request
-            out_ = server.generate(prompt, max_new_tokens=max_new,
-                                   prefix=prefix, return_logprobs=want_lp,
-                                   **sample_kwargs)
+            # shared-prefix KV reuse: only the suffix prefills per
+            # request — and under continuous batching the prefix row
+            # joins the shared engine batch (VERDICT r5 #3c; the
+            # batcher falls back solo when its cache can't hold a
+            # full-window prefix carry)
+            if continuous is not None and len(prompt) == 1:
+                out_ = continuous.generate(
+                    prompt[0], max_new_tokens=max_new, prefix=prefix,
+                    return_logprobs=want_lp, **sample_kwargs)
+            else:
+                out_ = server.generate(prompt, max_new_tokens=max_new,
+                                       prefix=prefix,
+                                       return_logprobs=want_lp,
+                                       **sample_kwargs)
             toks, lps = out_ if want_lp else (out_, None)
-        elif want_lp:
-            # logprobs ride the compile-once server path (solo: the fused
-            # program returns them alongside the tokens)
-            toks, lps = server.generate(prompt, max_new_tokens=max_new,
-                                        return_logprobs=True, **sample_kwargs)
         else:
-            toks = np.asarray(
-                jax.device_get(run(prompt, max_new, sample_kwargs)))
+            out_ = run(prompt, max_new, sample_kwargs, want_lp)
+            toks, lps = out_ if want_lp else (out_, None)
+            toks = np.asarray(jax.device_get(toks))
         toks = np.asarray(toks)
         out = {"ok": True, "tokens": toks.tolist(), "n_new": int(toks.shape[-1]),
                # effective request metadata for API shims (/v1/completions):
@@ -630,14 +679,6 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             return
         (prompt, max_new, sample_kwargs, from_text, prefix, want_lp,
          spec_k) = parsed
-        if spec_k is not None:
-            # speculation doesn't stream (yet): silently serving plain
-            # decode would let clients benchmark the wrong thing
-            yield {"ok": False, "error":
-                   "speculative decoding does not compose with stream "
-                   "(segments already bound time-to-first-token); drop "
-                   "one of the two knobs"}
-            return
         # clamp the client's segment size to a pow-2 in [4, 64]: it is
         # part of the compiled-program key, and an arbitrary per-request
         # value would grow the program cache (and pay a compile) without
@@ -645,12 +686,30 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         from lambdipy_tpu.models.llama import _next_bucket
 
         segment = min(64, _next_bucket(max(4, int(req.get("segment") or 16)), 4))
+        spec_stats = None
+        if spec_k is not None:
+            # speculative + stream (VERDICT r5 weak #2): each verify
+            # step's accepted chunk is a stream segment — TTFT is one
+            # prefill + one verify step, where speculation pays most
+            spec_stats = {}
+            chunks_iter = server.generate_speculative_stream(
+                prompt[0], max_new_tokens=max_new, k=spec_k,
+                eos_id=sample_kwargs["eos_id"], return_logprobs=want_lp,
+                stats_out=spec_stats)
+        elif continuous is not None and len(prompt) == 1:
+            # under continuous batching a streamed single-row request
+            # joins the shared engine batch and receives its slice per
+            # engine segment (VERDICT r5 #3b)
+            chunks_iter = continuous.generate_stream(
+                prompt[0], max_new_tokens=max_new, segment=segment,
+                prefix=prefix, return_logprobs=want_lp, **sample_kwargs)
+        else:
+            chunks_iter = server.generate_stream(
+                prompt, max_new_tokens=max_new, segment=segment,
+                prefix=prefix, return_logprobs=want_lp, **sample_kwargs)
         all_rows = None
         text_emitted = ""
-        for chunk in server.generate_stream(prompt, max_new_tokens=max_new,
-                                            segment=segment, prefix=prefix,
-                                            return_logprobs=want_lp,
-                                            **sample_kwargs):
+        for chunk in chunks_iter:
             chunk, lp_chunk = chunk if want_lp else (chunk, None)
             all_rows = (chunk if all_rows is None
                         else np.concatenate([all_rows, chunk], axis=1))
@@ -685,6 +744,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         out = {"ok": True, "done": True, "n_new": n_new,
                "n_prompt": int(sum(len(r) for r in prompt)
                                + (len(prefix) if prefix is not None else 0))}
+        if spec_stats is not None:
+            out["speculative"] = spec_stats
         if sample_kwargs["eos_id"] is not None:
             out["eos_id"] = sample_kwargs["eos_id"]
         if prefix is not None:
@@ -722,6 +783,12 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                "compile_count": server.compile_count,
                "program_evictions": server.program_evictions,
                "aot_hits": getattr(server, "aot_hits", 0)}
+        if preload_state:
+            # programs deserialized concurrently with the weight upload
+            # (cold-start overlap): count + seconds the preload took
+            out["aot_preload"] = {
+                "programs": len(preload_state.get("names", ())),
+                "seconds": preload_state.get("seconds")}
         if batcher is not None:
             out["batching"] = batcher.stats()
         if warm_state["requested"]:

@@ -226,3 +226,63 @@ def test_partial_stream_pair_saves_and_loads(tmp_path):
     out = cb2.generate([1, 2, 3], max_new_tokens=8)
     np.testing.assert_array_equal(out, ref)
     assert server2.aot_hits >= 1, "second boot must load the seg half"
+
+
+@pytest.mark.slow  # dual-tier exports on one core
+def test_preload_overlaps_weight_load(tmp_path):
+    """Cold-start overlap (VERDICT r5 #5): AotStore.preload deserializes
+    serving programs WITHOUT operands (so a boot can run it while the
+    weights upload), and load() then consumes the preloaded callable —
+    same outputs, counted as AOT hits."""
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    store = AotStore(tmp_path, gate_ms=60000)
+    server = LlamaServer(adapter.module, params, aot=store)
+    ref = server.generate([1, 2, 3], max_new_tokens=8)
+    assert server.aot_save_all() > 0
+
+    store2 = AotStore(tmp_path, gate_ms=60000)
+    pre = store2.preload()          # no params anywhere in sight
+    assert pre["names"], "saved serving programs must preload"
+    assert store2._preloaded
+    server2 = LlamaServer(adapter.module, params, aot=store2)
+    out = server2.generate([1, 2, 3], max_new_tokens=8)
+    np.testing.assert_array_equal(out, ref)
+    assert server2.aot_hits >= 1
+    # the consumed names came out of the preload dict
+    assert len(store2._preloaded) < len(pre["names"])
+
+
+def test_preload_skips_env_mismatch(tmp_path, tiny_model):
+    """preload never hands back an artifact from another environment."""
+    import json as _json
+
+    adapter, params, x = tiny_model
+    ctx = _ctx(tmp_path)
+    cached_jit(ctx, "srv-fake", adapter.forward, (params, x))
+    meta_path = next((tmp_path / "aot").glob("srv-fake.*.json"))
+    meta = _json.loads(meta_path.read_text())
+    meta["jaxlib"] = "0.0.0-other"
+    meta_path.write_text(_json.dumps(meta))
+    store = AotStore(tmp_path)
+    pre = store.preload()
+    assert pre["names"] == []
+
+
+def test_preload_skips_stale_generation(tmp_path, tiny_model):
+    """A previous generation's orphaned serving artifacts must not be
+    device-loaded by preload (they'd never be consumed)."""
+    from lambdipy_tpu.models.llama import LlamaServer
+
+    adapter, params, x = tiny_model
+    ctx = _ctx(tmp_path)
+    # a fake stale-generation artifact, valid for this environment
+    cached_jit(ctx, "srv-g1-dec-1-16-16", adapter.forward, (params, x))
+    store = AotStore(tmp_path)
+    pre = store.preload(prefix=LlamaServer.aot_prefix())
+    assert pre["names"] == []
+    # the generic prefix still sees it (the stale skip is the caller's
+    # generation-scoped prefix, not a hidden filter)
+    assert AotStore(tmp_path).preload()["names"] == ["srv-g1-dec-1-16-16"]

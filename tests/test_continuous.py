@@ -103,15 +103,31 @@ def test_logprobs_ride_continuous_batching(tiny_server):
     np.testing.assert_allclose(lps, sl, rtol=1e-5, atol=1e-6)
 
 
-def test_sampled_requests_bypass_to_solo(tiny_server):
-    """temperature > 0 must run solo (seed reproducibility) — identical
-    to the server's own sampled output."""
-    cb = ContinuousBatcher(tiny_server, slots=2, segment=4)
-    got = cb.generate([1, 2, 3], max_new_tokens=6, temperature=0.9, seed=7)
-    ref = tiny_server.generate([1, 2, 3], max_new_tokens=6,
-                               temperature=0.9, seed=7)
-    np.testing.assert_array_equal(got, ref)
-    assert cb.stats()["segments_run"] == 0  # never touched the engine
+def test_sampled_requests_batch_with_parity(tiny_server):
+    """Sampled (temperature > 0) requests ride the engine (VERDICT r5
+    #2) and every row — sampled next to greedy next to differently-
+    knobbed sampled traffic — produces exactly its solo output: per-row
+    knob operands + seed-derived per-row PRNG chains make a row's
+    sample independent of batch composition."""
+    cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
+    reqs = [
+        dict(prompt=[1, 2, 3], kw=dict(temperature=0.9, seed=7)),
+        dict(prompt=[9, 8, 7, 6], kw={}),  # greedy neighbor
+        dict(prompt=[4, 4], kw=dict(temperature=1.5, top_k=3, seed=11)),
+        dict(prompt=[5, 6, 7], kw=dict(temperature=0.7, top_p=0.9,
+                                       seed=3)),
+    ]
+    solo = [tiny_server.generate(r["prompt"], max_new_tokens=8, **r["kw"])
+            for r in reqs]
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        futs = [ex.submit(cb.generate, r["prompt"], max_new_tokens=8,
+                          **r["kw"]) for r in reqs]
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(), solo[i],
+                                          err_msg=f"request {i} diverged")
+    stats = cb.stats()
+    assert stats["requests_served"] == 4, stats
+    assert stats["rows_in_segments"] > stats["segments_run"], stats
 
 
 def test_over_cache_len_falls_back_to_solo(tiny_server):
@@ -205,3 +221,65 @@ def test_http_continuous_batching_end_to_end(tmp_path):
         assert engine["rows_in_segments"] > engine["segments_run"], engine
     finally:
         server.stop()
+
+
+def test_stream_rides_the_engine(tiny_server):
+    """A streamed request joins the SHARED engine batch (VERDICT r5
+    #3b): its chunk concatenation equals the fused output while another
+    request decodes concurrently in the same segments."""
+    cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
+    fused = tiny_server.generate([1, 2, 3], max_new_tokens=11)
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        f_other = ex.submit(cb.generate, [9, 8, 7], max_new_tokens=8)
+        chunks = list(cb.generate_stream([1, 2, 3], max_new_tokens=11))
+        other = f_other.result()
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1), fused)
+    np.testing.assert_array_equal(
+        other, tiny_server.generate([9, 8, 7], max_new_tokens=8))
+    stats = cb.stats()
+    assert stats["rows_in_segments"] > stats["segments_run"], stats
+
+
+def test_stream_eos_and_logprobs_through_engine(tiny_server):
+    """Engine streaming latches eos with fused-path parity and carries
+    logprobs."""
+    cb = ContinuousBatcher(tiny_server, slots=2, segment=4)
+    fused = tiny_server.generate([1, 2, 3], max_new_tokens=11)
+    eos = int(fused[0, 1])
+    ref = tiny_server.generate([1, 2, 3], max_new_tokens=11, eos_id=eos)
+    got = np.concatenate(list(cb.generate_stream(
+        [1, 2, 3], max_new_tokens=11, eos_id=eos)), axis=1)
+    assert got.shape[1] < 11  # stopped at a segment boundary
+    np.testing.assert_array_equal(got, ref[:, :got.shape[1]])
+    ft, fl = tiny_server.generate([5, 6], max_new_tokens=8,
+                                  return_logprobs=True)
+    pairs = list(cb.generate_stream([5, 6], max_new_tokens=8,
+                                    return_logprobs=True))
+    np.testing.assert_array_equal(
+        np.concatenate([p[0] for p in pairs], axis=1), ft)
+    np.testing.assert_allclose(
+        np.concatenate([p[1] for p in pairs], axis=1), fl,
+        rtol=1e-5, atol=1e-6)
+
+
+def test_prefix_rows_join_the_engine(tiny_server):
+    """A prefix-cached request packs its continuation carry into an
+    engine slot (VERDICT r5 #3c): output equals the full-prompt fused
+    output, streamed and not, while sharing segments with other
+    traffic; a cache-capped engine falls back solo instead."""
+    cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
+    prefix = list(range(1, 20))
+    full = tiny_server.generate(prefix + [4, 5], max_new_tokens=8)
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        f_other = ex.submit(cb.generate, [9, 8, 7], max_new_tokens=8)
+        via = cb.generate([4, 5], max_new_tokens=8, prefix=prefix)
+        f_other.result()
+    np.testing.assert_array_equal(via, full)
+    st = np.concatenate(list(cb.generate_stream(
+        [4, 5], max_new_tokens=8, prefix=prefix)), axis=1)
+    np.testing.assert_array_equal(st, full)
+    capped = ContinuousBatcher(tiny_server, slots=2, segment=4,
+                               cache_len=32)
+    np.testing.assert_array_equal(
+        capped.generate([4, 5], max_new_tokens=8, prefix=prefix), full)
+    assert capped.stats()["segments_run"] == 0  # solo fallback

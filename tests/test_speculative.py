@@ -114,10 +114,12 @@ def test_handler_speculative_knob(tmp_path):
     assert not bad2["ok"]
 
 
-def test_speculative_stats_fallback_and_stream_rejection(tmp_path):
+def test_speculative_stats_fallback_and_stream_compose(tmp_path):
     """The fallback path returns its own stats (never another request's),
-    and stream + speculative is a clean error instead of a silent plain
-    decode."""
+    and stream + speculative composes (VERDICT r5 weak #2): chunks are
+    per-verify-step accepted prefixes whose concatenation equals the
+    non-streamed speculative output, with the acceptance counters on
+    the final record."""
     from tests.test_runtime import make_model_bundle
     from lambdipy_tpu.runtime.loader import load_bundle
 
@@ -132,9 +134,21 @@ def test_speculative_stats_fallback_and_stream_rejection(tmp_path):
                                   "speculative": 8, "max_new_tokens": 8})
     assert long["ok"], long
     assert long["speculative"].get("fallback") == "plain", long["speculative"]
+    fused = report.handler.invoke(
+        report.state, {"tokens": [5, 6, 7, 8], "speculative": 4,
+                       "max_new_tokens": 16})
     chunks = list(report.state.invoke_stream(
-        {"tokens": [1, 2, 3], "speculative": 8, "stream": True}))
-    assert chunks[0]["ok"] is False and "stream" in chunks[0]["error"]
+        {"tokens": [5, 6, 7, 8], "speculative": 4, "stream": True,
+         "max_new_tokens": 16}))
+    assert all(c["ok"] for c in chunks), chunks
+    streamed = [t for c in chunks if c.get("tokens")
+                for t in c["tokens"][0]]
+    assert streamed == fused["tokens"][0]
+    final = chunks[-1]
+    assert final.get("done") and final["speculative"]["steps"] >= 1
+    # per-step chunks: with acceptance happening, fewer chunks than
+    # tokens proves multi-token segments flowed
+    assert len(chunks) - 1 <= final["speculative"]["steps"]
 
 
 def test_speculative_bypasses_continuous_batcher(tmp_path):
@@ -159,3 +173,40 @@ def test_speculative_bypasses_continuous_batcher(tmp_path):
     # request enqueued into it would make this 2
     assert engine["requests_served"] == 1, engine
     assert "speculative" in spec
+
+
+def test_speculative_stream_matches_fused(tiny_server):
+    """Server-level parity: generate_speculative_stream chunk concat ==
+    generate_speculative output (including through an eos latch), with
+    logprobs riding and stats_out filled per request."""
+    import numpy as np
+
+    fused, stats = tiny_server.generate_speculative(
+        [5, 6, 7, 8], max_new_tokens=16, k=4, return_stats=True)
+    out_stats = {}
+    chunks = list(tiny_server.generate_speculative_stream(
+        [5, 6, 7, 8], max_new_tokens=16, k=4, stats_out=out_stats))
+    st = np.concatenate(chunks, axis=1)
+    np.testing.assert_array_equal(st, fused[:, :st.shape[1]])
+    assert st.shape[1] == 16
+    assert out_stats["steps"] == stats["steps"], (out_stats, stats)
+    # logprobs parity
+    ft, fl = tiny_server.generate_speculative(
+        [1, 2, 3], max_new_tokens=12, k=4, return_logprobs=True)
+    pairs = list(tiny_server.generate_speculative_stream(
+        [1, 2, 3], max_new_tokens=12, k=4, return_logprobs=True))
+    st = np.concatenate([p[0] for p in pairs], axis=1)
+    sl = np.concatenate([p[1] for p in pairs], axis=1)
+    np.testing.assert_array_equal(st, ft[:, :st.shape[1]])
+    np.testing.assert_allclose(sl, fl[:, :sl.shape[1]], rtol=1e-5,
+                               atol=1e-6)
+    # eos: stream stops at the latch; fused pads with filler after it
+    free = tiny_server.generate_speculative([5, 6, 7, 8],
+                                            max_new_tokens=10)
+    eos = int(free[0, 2])
+    ref = tiny_server.generate_speculative([5, 6, 7, 8],
+                                           max_new_tokens=10, eos_id=eos)
+    got = np.concatenate(list(tiny_server.generate_speculative_stream(
+        [5, 6, 7, 8], max_new_tokens=10, k=4, eos_id=eos)), axis=1)
+    np.testing.assert_array_equal(got, ref[:, :got.shape[1]])
+    assert got[0, -1] == eos
