@@ -61,7 +61,8 @@ class ContinuousBatcher:
     """Segment-boundary continuous batching over a LlamaServer."""
 
     def __init__(self, server: Any, *, slots: int = 8, segment: int = 16,
-                 cache_len: int | None = None):
+                 cache_len: int | None = None,
+                 group_prefill_max: int = 256):
         import jax
 
         self.server = server
@@ -69,6 +70,15 @@ class ContinuousBatcher:
         self.slots = max(1, slots)
         self.segment = max(1, segment)
         self.cache_len = min(cache_len or cfg.max_len, cfg.max_len)
+        # prompts up to this length enqueue RAW and the engine prefills
+        # them together in one ragged b-row call (prefill MFU at short
+        # prompts scales with rows — 8 x 16-token prefills are one
+        # 128-row-equivalent matmul instead of eight skinny ones);
+        # longer prompts prefill on their request thread (chunked when
+        # the server has prefill_chunk), whose chunk dispatches
+        # interleave with engine segments on the device queue instead
+        # of stalling in-flight decode behind one wide program
+        self.group_prefill_max = max(0, group_prefill_max)
         del jax  # imported for device presence; carry is built lazily
         self._lock = threading.Condition()
         self._joiners: list[dict] = []   # prefilled rows awaiting a slot
@@ -101,30 +111,33 @@ class ContinuousBatcher:
                 jnp.zeros((b,), jnp.bool_),      # done (never latches)
                 jnp.zeros((b, 2), jnp.uint32))   # per-row PRNG keys
 
-    def _pack(self, carry, row_carry, slot: int):
-        """Write the 1-row carry into batch slot ``slot`` (one compiled
-        program for every slot: the index is a traced operand)."""
+    def _pack(self, carry, group_carry, src: int, slot: int):
+        """Write row ``src`` of a (1..b)-row carry into batch slot
+        ``slot`` (one compiled program per source-carry batch size: the
+        row and slot indices are traced operands)."""
         import jax
 
         if self._pack_fn is None:
-            def pack(batch_carry, row_carry, slot):
-                def upd(b_leaf, r_leaf):
+            def pack(batch_carry, group_carry, src, slot):
+                def upd(b_leaf, g_leaf):
+                    row = jax.lax.dynamic_slice_in_dim(g_leaf, src, 1, 0)
                     return jax.lax.dynamic_update_slice_in_dim(
-                        b_leaf, r_leaf.astype(b_leaf.dtype), slot, 0)
+                        b_leaf, row.astype(b_leaf.dtype), slot, 0)
 
                 tok, lp, cache, pos, done, keys = batch_carry
-                rtok, rlp, rcache, rpos, rdone, rkeys = row_carry
-                new_cache = [{k: upd(c[k], rc[k]) for k in c}
-                             for c, rc in zip(cache, rcache)]
+                gtok, glp, gcache, gpos, gdone, gkeys = group_carry
+                new_cache = [{k: upd(c[k], gc[k]) for k in c}
+                             for c, gc in zip(cache, gcache)]
                 # the row's PRNG chain packs too: its post-prefill key
                 # continues exactly where solo decode would be
-                return (upd(tok, rtok), upd(lp, rlp), new_cache,
-                        upd(pos, rpos), upd(done, rdone), upd(keys, rkeys))
+                return (upd(tok, gtok), upd(lp, glp), new_cache,
+                        upd(pos, gpos), upd(done, gdone), upd(keys, gkeys))
 
             self._pack_fn = jax.jit(pack)
         import jax.numpy as jnp
 
-        return self._pack_fn(carry, row_carry, jnp.int32(slot))
+        return self._pack_fn(carry, group_carry, jnp.int32(src),
+                             jnp.int32(slot))
 
     def _prefill_row(self, row, s: int, entry: dict):
         """Single-row bucketed prefill -> 1-row carry over the engine's
@@ -145,6 +158,71 @@ class ContinuousBatcher:
             entry["seed"], None, b=1)
         with server._mesh_ctx():
             return prefill(server.params, prompt_op, length_op, *knobs)
+
+    def _prefill_group(self, entries: list):
+        """ONE ragged b-row prefill for all waiting short-prompt joiners
+        (VERDICT r5 #4: prefill is compute-bound and short prompts run
+        it at tiny row counts — 8 joiners' 16-token prefills are one
+        128-row-equivalent matmul instead of eight skinny ones). Each
+        row prefills under its own knobs/seed; row-exactness of the
+        ragged prefill keeps solo parity. Returns the group carry;
+        entry i packs from row i."""
+        from lambdipy_tpu.models.llama import _next_bucket
+
+        server = self.server
+        rows = [e["row"] for e in entries]
+        lens = [e["s"] for e in entries]
+        bb = _next_bucket(len(rows), 1)
+        sb = max(max(lens), min(_next_bucket(max(lens), server.min_bucket),
+                                self.cache_len))
+        prefill, _ = server._stream_fns(bb, sb, self.cache_len,
+                                        self.segment)
+        prompt_op, length_op = server._pad_rows(rows, lens, bb, sb)
+        knobs = server._knob_operands(
+            [e["temperature"] for e in entries],
+            [e["top_k"] for e in entries],
+            [e["top_p"] for e in entries],
+            [e["seed"] for e in entries],
+            None, b=bb)
+        with server._mesh_ctx():
+            return prefill(server.params, prompt_op, length_op, *knobs)
+
+    def _prefill_row_chunked(self, row, s: int, entry: dict):
+        """Long-prompt joiner prefill through fixed-width chunks: each
+        chunk is its own device dispatch, so ENGINE SEGMENTS INTERLEAVE
+        with the prefill on the device queue instead of in-flight decode
+        stalling behind one wide prefill program (VERDICT r5 #4), and
+        dense-attention memory stays O(chunk x s). Reuses the server's
+        chunked-prefix program families; the final sub-chunk tail runs
+        the carry-producing continuation. Parity class matches chunked
+        prefix prefill: exact with the float KV cache (asserted in f32
+        tests), quantization tolerance under kv_quant."""
+        import jax.numpy as jnp
+
+        from lambdipy_tpu.models.llama import _next_bucket
+
+        server = self.server
+        ck = server.prefill_chunk
+        split = ((s - 1) // ck) * ck  # >= 1 token left for continuation
+        if split == 0:
+            return self._prefill_row(row, s, entry)
+        tail = row[split:]
+        with server._mesh_ctx():
+            cache = server._chunked_prefill_cache(row, split,
+                                                  self.cache_len)
+            sbs = min(_next_bucket(len(tail), server.min_bucket),
+                      self.cache_len - split)
+            # a full-window engine shares the prefix path's continuation
+            # program (and its AOT executable); a capped one keys its own
+            full = self.cache_len == server.model.cfg.max_len
+            cont = server._stream_prefix_fn(
+                sbs, cache_len=None if full else self.cache_len)
+            suffix_op, _ = server._pad_rows([tail], [len(tail)], 1, sbs)
+            knobs = server._knob_operands(
+                entry["temperature"], entry["top_k"], entry["top_p"],
+                entry["seed"], None, b=1)
+            return cont(server.params, cache, suffix_op,
+                        jnp.int32(len(tail)), *knobs)
 
     def _segment_fn(self):
         """The B-slot segment program (shared with streaming's family —
@@ -197,8 +275,32 @@ class ContinuousBatcher:
                     return
             if self._carry is None:
                 self._carry = self._init_carry()
-            for joiner in packing:
-                self._carry = self._pack(self._carry, joiner["carry"],
+            raw = [a for a in packing if a.get("carry") is None]
+            carried = [a for a in packing if a.get("carry") is not None]
+            group_carry = None
+            if raw:
+                try:
+                    group_carry = self._prefill_group(raw)
+                except Exception as e:  # noqa: BLE001
+                    # a group-prefill failure (fresh-bucket compile
+                    # OOM, transient device error) errors ONLY the raw
+                    # joiners — in-flight decode and carried joiners
+                    # keep running, matching the isolation request-
+                    # thread prefill used to provide
+                    log.error("group prefill failed: %s", e)
+                    with self._lock:
+                        for j in raw:
+                            j["error"], j["done"] = e, True
+                            self._active[j["slot"]] = None
+                        self._lock.notify_all()
+                    raw = []
+            for src, joiner in enumerate(raw):
+                self._carry = self._pack(self._carry, group_carry, src,
+                                         joiner["slot"])
+                joiner["packed"] = True
+            group_carry = None  # free the group cache
+            for joiner in carried:
+                self._carry = self._pack(self._carry, joiner["carry"], 0,
                                          joiner["slot"])
                 joiner["carry"] = None  # free the 1-row cache
                 joiner["packed"] = True
@@ -306,11 +408,24 @@ class ContinuousBatcher:
                 # can't hold
                 return None
             self.server._validate(s, max_new_tokens)
-            # prefill alone under the row's own knobs; the engine's
-            # segments emit the tokens (the scan re-emits the carry's
-            # first token, so everything flows from the segment outputs
-            # — nothing is delivered eagerly)
-            entry["carry"] = self._prefill_row(row, s, entry)
+            # The engine's segments emit the tokens either way (the
+            # scan re-emits the carry's first token, so everything
+            # flows from the segment outputs — nothing is delivered
+            # eagerly). Short prompts enqueue RAW and the engine
+            # prefills waiting joiners together in one ragged call;
+            # long prompts prefill here on the request thread — in
+            # chunks when the server has prefill_chunk, so engine
+            # segments interleave instead of stalling.
+            if s <= self.group_prefill_max:
+                entry["row"], entry["s"] = row, s
+                entry["carry"] = None
+            else:
+                ck = self.server.prefill_chunk
+                if ck and s > ck and self.cache_len % ck == 0:
+                    entry["carry"] = self._prefill_row_chunked(row, s,
+                                                               entry)
+                else:
+                    entry["carry"] = self._prefill_row(row, s, entry)
         with self._lock:
             self._joiners.append(entry)
             if not self._engine_running:

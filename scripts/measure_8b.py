@@ -405,6 +405,93 @@ def measure_concurrent(n_requests: int = 8, n_new: int = 64) -> dict:
     return rec
 
 
+def measure_prefill(lens=(512, 1024, 4096), flash_len: int = 8192,
+                    batch_len: int = 512, batch: int = 4) -> dict:
+    """The prefill table (VERDICT r5 #4 + #9): dense prefill
+    latency/MFU at 512/1k/4k, a BATCHED 512 prefill (does MFU scale
+    with rows?), and the long-context paths at 8k — flash attention
+    (dense would materialize an 8.6 GB score tensor per layer) and
+    chunked prefill — all at real 8B dims with an 8192 window."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _measure_rtt_ms
+    from lambdipy_tpu.bundle import flatpack
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.models.llama import LlamaConfig
+    from lambdipy_tpu.utils import roofline
+
+    dims = dict(DIMS, max_len=max(flash_len, 8192))
+    ensure_params(params_path())
+    params = flatpack.device_load(params_path())
+    for leaf in jax.tree.leaves(params)[-1:]:
+        float(jnp.asarray(leaf).astype(jnp.float32).sum())
+    rtt = _measure_rtt_ms(jax, jnp)
+    cfg = LlamaConfig(**dims, quant="int8", dtype=jnp.bfloat16)
+    rec: dict = {"dims": f"{dims['hidden']}x{dims['layers']}"
+                         f"x{dims['vocab_size']}",
+                 "max_len": dims["max_len"], "rtt_ms": round(rtt, 1),
+                 "measured_at": time.strftime("%Y-%m-%d"),
+                 "rows": []}
+
+    def time_prefill(server, L, b=1, label="dense"):
+        rows = [list(range(1, L + 1))] * b
+        t0 = time.monotonic()
+        server.generate(rows, max_new_tokens=1)
+        compile_s = time.monotonic() - t0
+        times = [_timed(lambda: server.generate(rows, max_new_tokens=1))
+                 for _ in range(3)]
+        net_ms = max(0.1, statistics.median(times) - rtt)
+        cost = roofline.llama_prefill_cost(cfg, batch=b, seq_len=L)
+        row = {"backend": label, "len": L, "batch": b,
+               "net_ms": round(net_ms, 1),
+               "mfu": cost.utilization(net_ms / 1e3)["mfu"],
+               "compile_s": round(compile_s, 1)}
+        rec["rows"].append(row)
+        print(json.dumps(row), file=sys.stderr)
+
+    adapter = registry.get("llama3-8b").build(
+        dtype="bfloat16", quant="int8", extra=dims)
+    server = adapter.make_server(params)
+    for L in lens:
+        time_prefill(server, L)
+    time_prefill(server, batch_len, b=batch)  # batched prefill
+    # flash attention at 8k (the O(S)-memory fallback's reason to exist)
+    fl = registry.get("llama3-8b").build(
+        dtype="bfloat16", quant="int8",
+        extra=dict(dims, attn_backend="flash"))
+    time_prefill(fl.make_server(params), flash_len, label="flash")
+    # chunked prefill at 8k via the prefix machinery (512-token chunks)
+    ck_server = adapter.make_server(params, prefill_chunk=512)
+    long_tokens = list(range(1, flash_len + 1))
+    ck_server.cache_prefix(long_tokens[:1024])  # compile first+ext
+
+    def chunked_once():
+        key = ck_server.cache_prefix(long_tokens)
+        # cache_prefix only SUBMITS the chunk walk (and on this
+        # transport block_until_ready returns at submission): fetch a
+        # scalar reduction of the last layer's cache so the timed
+        # region observes the device actually finish, matching
+        # time_prefill's device_get methodology
+        with ck_server._prefix_lock:
+            cache, _ = ck_server._prefixes.pop(key)  # pop: re-time fresh
+        leaf = jax.tree.leaves(cache)[-1]
+        float(jnp.asarray(leaf).astype(jnp.float32).sum())
+
+    t0 = time.monotonic()
+    chunked_once()
+    net_ms = max(0.1, (time.monotonic() - t0) * 1e3 - rtt)
+    cost = roofline.llama_prefill_cost(cfg, batch=1, seq_len=flash_len)
+    row = {"backend": "chunked512", "len": flash_len, "batch": 1,
+           "net_ms": round(net_ms, 1),
+           "mfu": cost.utilization(net_ms / 1e3)["mfu"]}
+    rec["rows"].append(row)
+    print(json.dumps(row), file=sys.stderr)
+    return rec
+
+
 def _publish(update) -> None:
     """Apply ``update(published, config5)`` to BASELINE.json atomically
     enough for this single-writer script (one read-modify-write)."""
@@ -431,9 +518,18 @@ def main() -> int:
                     help="measure N staggered requests through the "
                          "continuous-batching engine vs serial")
     ap.add_argument("--n-requests", type=int, default=8)
+    ap.add_argument("--prefill-table", action="store_true",
+                    help="measure the prefill table: dense 512/1k/4k, "
+                         "batched 512, flash + chunked at 8k")
     ap.add_argument("--publish", action="store_true",
                     help="record into BASELINE.json published.config5")
     args = ap.parse_args()
+    if args.prefill_table:
+        record = measure_prefill()
+        print(json.dumps(record, indent=2))
+        if args.publish:
+            _publish(lambda pub, c5: c5.__setitem__("prefill", record))
+        return 0
     if args.concurrent:
         record = measure_concurrent(n_requests=args.n_requests,
                                     n_new=args.n_new)
