@@ -372,3 +372,48 @@ def test_flatpack_device_load_64bit_falls_back_to_host(tmp_path):
     assert out["a"].dtype == np.int64
     np.testing.assert_array_equal(out["a"], tree["a"])
     np.testing.assert_array_equal(out["b"], tree["b"])
+
+
+def test_int8_kv_error_bound_at_real_head_dims():
+    """The int8 KV quantization error bound at the REAL 8B head layout
+    (kv_heads=8, head_dim=128) rather than toy dims (VERDICT r5 #7):
+    per-vector symmetric int8 keeps the K/V roundtrip within the
+    ~0.4%-of-max bound the docs claim, and attention outputs through
+    the real-dims _attend core stay within a small relative error of
+    the float-cache path across realistic magnitude spreads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lambdipy_tpu.models.llama import (_attend, _kv_dequantize,
+                                           _kv_quantize)
+
+    b, t, kvh, d = 2, 256, 8, 128  # real 8B kv-head geometry, 1k-ish ctx
+    rng = np.random.default_rng(0)
+    for scale in (0.05, 1.0, 30.0):  # bf16-typical through outlier rows
+        kv = jnp.asarray(rng.standard_normal((b, t, kvh, d)) * scale,
+                         jnp.float32)
+        q_i8, q_s = _kv_quantize(kv)
+        back = _kv_dequantize(q_i8, q_s, jnp.float32)
+        # round-to-nearest per-vector symmetric int8:
+        # |err| <= 0.5 * scale = max|x|/254 per vector — the ~0.4%-of-
+        # max bound the LlamaConfig.kv_quant docs claim (a regression
+        # to truncation would double this and fail here)
+        per_vec_max = np.max(np.abs(np.asarray(kv)), axis=-1,
+                             keepdims=True)
+        err = np.abs(np.asarray(back) - np.asarray(kv))
+        assert (err <= per_vec_max / 254.0 + 1e-6).all()
+
+    # attention-output error vs the float cache at real head dims
+    h = kvh * 4  # 32 query heads (GQA group 4), the 8B layout
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, kvh, d)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, kvh, d)) * 0.3, jnp.float32)
+    mask = jnp.ones((b, 1, t), jnp.bool_)
+    ref = np.asarray(_attend(q, k, v, mask))
+    k8 = _kv_dequantize(*_kv_quantize(k), jnp.float32)
+    v8 = _kv_dequantize(*_kv_quantize(v), jnp.float32)
+    got = np.asarray(_attend(q, k8, v8, mask))
+    rel = np.abs(got - ref) / (np.abs(ref).mean() + 1e-9)
+    assert float(rel.mean()) < 0.01, float(rel.mean())
+    assert float(rel.max()) < 0.15, float(rel.max())
