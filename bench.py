@@ -380,6 +380,27 @@ def main() -> int:
                 # the TRANSPORT was down at bench time, not that the
                 # stack regressed
                 _attach_last_device_record(result)
+                # ...and the session's timestamped probe attempts, so
+                # the artifact proves reruns were attempted throughout
+                # the round, not once at its end (VERDICT r5 #10).
+                # Best-effort: a probe killed mid-write leaves a
+                # truncated line, and informational context must never
+                # break the bench line itself.
+                try:
+                    probe_log = os.path.join(here, "PROBE_LOG.jsonl")
+                    if os.path.isfile(probe_log):
+                        with open(probe_log) as f:
+                            lines = [ln.strip() for ln in f if ln.strip()]
+                        tail = []
+                        for ln in lines[-6:]:
+                            try:
+                                tail.append(json.loads(ln))
+                            except json.JSONDecodeError:
+                                continue
+                        if tail:
+                            result["probe_log_tail"] = tail
+                except Exception:  # noqa: BLE001
+                    pass
             result["stages"] = stages_log
             print(json.dumps(result))
             return 0

@@ -408,3 +408,31 @@ def test_chunked_joiner_on_capped_engine():
     assert key[2] == 64
     assert LlamaServer._aot_name(key) is not None
     assert server._aot_examples(key) is not None  # 3-tuple synthesizes
+
+
+def test_engine_over_tp_sharded_server(cpu_devices):
+    """The continuous engine over a TENSOR-PARALLEL server (the 8B
+    recipe's default shape: batch_mode=continuous + tp mesh): packed
+    decode matches the unsharded solo output."""
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+    from lambdipy_tpu.parallel.sharding import shard_params
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    ref_server = adapter.make_server(params)
+    refs = [ref_server.generate(p, max_new_tokens=8)
+            for p in ([1, 2, 3], [9, 8, 7, 6])]
+
+    mesh = make_mesh({"tp": 2}, devices=cpu_devices[:2])
+    with use_mesh(mesh):
+        sharded = shard_params(params, mesh, adapter.tp_rules)
+    server = adapter.make_server(sharded, mesh=mesh)
+    cb = ContinuousBatcher(server, slots=2, segment=4)
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        fa = ex.submit(cb.generate, [1, 2, 3], max_new_tokens=8)
+        fb = ex.submit(cb.generate, [9, 8, 7, 6], max_new_tokens=8)
+        np.testing.assert_array_equal(fa.result(), refs[0])
+        np.testing.assert_array_equal(fb.result(), refs[1])
+    stats = cb.stats()
+    assert stats["rows_in_segments"] > stats["segments_run"], stats
