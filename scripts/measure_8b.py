@@ -488,10 +488,10 @@ def measure_kv_quant(n_new: int = 64, context: int = 1024) -> dict:
     return rec
 
 
-def measure_prefill(lens=(512, 1024, 4096), flash_len: int = 8192,
+def measure_prefill(lens=(512, 1024, 2048, 4096), flash_len: int = 8192,
                     batch_len: int = 512, batch: int = 4) -> dict:
     """The prefill table (VERDICT r5 #4 + #9): dense prefill
-    latency/MFU at 512/1k/4k, a BATCHED 512 prefill (does MFU scale
+    latency/MFU at 512/1k/2k/4k, a BATCHED 512 prefill (does MFU scale
     with rows?), and the long-context paths at 8k — flash attention
     (dense would materialize an 8.6 GB score tensor per layer) and
     chunked prefill — all at real 8B dims with an 8192 window."""
@@ -566,6 +566,37 @@ def measure_prefill(lens=(512, 1024, 4096), flash_len: int = 8192,
            "mfu": cost.utilization(net_ms / 1e3)["mfu"]}
     rec["rows"].append(row)
     print(json.dumps(row), file=sys.stderr)
+    # scaling decomposition (the "where do the missing MFU go" analysis,
+    # VERDICT r5 #4): fit t(s) = c0 + c1*s + c2*s^2 over the dense b=1
+    # points. The linear term is the weight-read + per-token matmul
+    # work, the quadratic term is attention score/AV work, the constant
+    # is dispatch/lm_head/fixed overhead — their shares at each length
+    # say whether low prefill MFU is an attention problem (quadratic
+    # share high) or an overhead problem (constant share high).
+    dense = [r for r in rec["rows"] if r["backend"] == "dense"
+             and r["batch"] == 1]
+    # >= 4 points: with exactly 3 the quadratic fit degenerates to
+    # interpolation and sample jitter maps straight into the published
+    # coefficients (the decomposition needs a residual DOF to mean
+    # anything)
+    if len(dense) >= 4:
+        import numpy as np
+
+        s_arr = np.array([r["len"] for r in dense], float)
+        t_arr = np.array([r["net_ms"] for r in dense], float)
+        c2, c1, c0 = (float(c) for c in np.polyfit(s_arr, t_arr, 2))
+        rec["scaling_fit"] = {
+            "const_ms": round(c0, 2), "linear_ms_per_tok": round(c1, 4),
+            "quad_ms_per_tok2": round(c2, 8),
+            "shares_at": {
+                str(int(s)): {
+                    "const": round(c0 / t, 2),
+                    "linear": round(c1 * s / t, 2),
+                    "quad": round(c2 * s * s / t, 2)}
+                for s, t in zip(s_arr, t_arr)},
+        }
+        print(json.dumps({"scaling_fit": rec["scaling_fit"]}),
+              file=sys.stderr)
     return rec
 
 
