@@ -52,12 +52,15 @@ class LlamaConfig:
     # halves that traffic and capacity for ~0.4% attention error; XLA
     # fuses the dequant into the attention einsum.
     kv_quant: str | None = None
-    # Prefill attention backend: "dense" (XLA-fused, default), "flash"
-    # (Pallas kernel when shapes tile), or "ring" (sequence-parallel ring
-    # attention over the ambient mesh's sp axis — the long-context path).
-    # Defaults measured, not assumed: docs/kernels.md — XLA dense wins at
-    # <=4k context on v5e; flash is the O(S)-memory fallback for contexts
-    # whose dense score tensor would not fit.
+    # Attention backend: "dense" (XLA-fused, default), "flash" (Pallas
+    # kernel when shapes tile), or "ring" — the LONG-CONTEXT pair:
+    # sequence-parallel ring attention for prefill AND sequence-sharded
+    # flash-decoding for decode steps over the ambient mesh's sp axis
+    # (parallel/ring.py + parallel/spdecode.py; the KV cache never
+    # gathers, per-step collectives are O(b*h*d)). Defaults measured,
+    # not assumed: docs/kernels.md — XLA dense wins at <=4k context on
+    # v5e; flash is the O(S)-memory fallback for contexts whose dense
+    # score tensor would not fit.
     attn_backend: str = "dense"
     # Sparse MoE FFN (Mixtral-style): >0 replaces the dense SwiGLU with
     # moe_experts top-k routed experts (models/moe.py), expert dim sharded
@@ -196,6 +199,22 @@ def _kv_dequantize(q_i8, scale, dtype):
     return q_i8.astype(dtype) * scale.astype(dtype)
 
 
+def _active_sp_mesh():
+    """The ambient mesh when sequence parallelism is usable: an ``sp``
+    axis > 1 and not inside a manual (shard_map / pipeline-stage) region
+    where a nested whole-mesh shard_map cannot trace. The ONE gate
+    shared by ring prefill and sp decode — they must agree, or prefill
+    would shard what decode then replicates."""
+    from lambdipy_tpu.parallel.mesh import current_mesh
+    from lambdipy_tpu.parallel.sharding import shard_hints_suppressed
+
+    mesh = current_mesh()
+    if (mesh is not None and mesh.shape.get("sp", 1) > 1
+            and not shard_hints_suppressed()):
+        return mesh
+    return None
+
+
 def _attend(q, k, v, mask):
     """Grouped-query attention core. q: [b,s,h,d]; k/v: [b,t,kvh,d].
 
@@ -231,21 +250,16 @@ class LlamaBlock(nn.Module):
         s = q.shape[1]
         backend = cfg.attn_backend
         if backend == "ring":
-            from lambdipy_tpu.parallel.mesh import current_mesh
             from lambdipy_tpu.parallel.ring import ring_attention
-            from lambdipy_tpu.parallel.sharding import shard_hints_suppressed
 
-            mesh = current_mesh()
-            # inside a manual region (e.g. a pipeline stage body) a nested
-            # whole-mesh shard_map cannot trace — fall back to dense there
-            if (mesh is not None and mesh.shape.get("sp", 1) > 1
-                    and not shard_hints_suppressed()):
+            mesh = _active_sp_mesh()
+            if mesh is not None:
                 # sequence-parallel long-context path; the padding mask is
                 # threaded as the ring's key-validity mask, so padded
                 # batches match the dense backend exactly
                 return ring_attention(q, k, v, mesh, causal=True,
                                       kv_mask=mask)
-            backend = "dense"  # no sp axis -> fall through
+            backend = "dense"  # no usable sp axis -> fall through
         if backend == "flash":
             from lambdipy_tpu.ops.attention import flash_attention
 
@@ -281,48 +295,74 @@ class LlamaBlock(nn.Module):
             # scan — the dominant serving HBM object must never be
             # gathered per step
             idx = cache["index"]  # int32 scalar, or [b] per-row positions
-            if cfg.kv_quant == "int8":
-                # quantize this chunk's k/v once; the cache stays int8 in
-                # HBM and the dequant fuses into the attention einsum
-                k_q, k_s = _kv_quantize(k)
-                v_q, v_s = _kv_quantize(v)
-                store = {"k_int8": k_q, "k_scale": k_s,
-                         "v_int8": v_q, "v_scale": v_s}
-            else:
-                store = {"k": k, "v": v}
-            new_cache = {}
-            if jnp.ndim(idx) == 0:
-                for name, val in store.items():
-                    new_cache[name] = jax.lax.dynamic_update_slice(
-                        cache[name], val, (0, idx, 0, 0))
-                # chunk query j attends keys <= idx + j — causal within
-                # the chunk, everything before it. s == 1 is the familiar
-                # decode-step mask; s > 1 is a multi-token continuation
-                # chunk (prefix-cache suffix prefill).
-                t = new_cache[next(iter(store))].shape[1]
-                valid = (jnp.arange(t)[None, None, :]
-                         <= (idx + jnp.arange(s))[None, :, None])  # [1, s, t]
-            else:
-                # ragged batch (rows decode from different prompt lengths):
-                # per-row scatter of this step's single position
-                assert s == 1, "per-row cache indices require one-token steps"
-                rows = jnp.arange(b)
-                for name, val in store.items():
-                    new_cache[name] = cache[name].at[rows, idx].set(val[:, 0])
-                t = new_cache[next(iter(store))].shape[1]
-                valid = (jnp.arange(t)[None, None, :]
-                         <= idx[:, None, None])  # [b, 1, t]
-            new_cache = {name: shard_hint(val, "dp", None, "tp")
-                         for name, val in new_cache.items()}
-            if cfg.kv_quant == "int8":
-                ck = _kv_dequantize(new_cache["k_int8"], new_cache["k_scale"],
-                                    cfg.dtype)
-                cv = _kv_dequantize(new_cache["v_int8"], new_cache["v_scale"],
-                                    cfg.dtype)
-            else:
-                ck, cv = new_cache["k"], new_cache["v"]
-            attn_mask = jnp.broadcast_to(valid, (b, s, t))
-            out = _attend(q, ck, cv, attn_mask)
+            # sequence-parallel decode (attn_backend="ring" + an sp
+            # mesh): the cache seq dim stays SHARDED over sp for the
+            # whole scan and each step combines per-shard online-softmax
+            # partials with O(b*h*d) collectives — the long-context
+            # decode path, pairing with ring-attention prefill
+            # (parallel/spdecode.py). float KV only; int8-KV falls
+            # through to the replicated path.
+            sp_done = False
+            if jnp.ndim(idx) != 0 and cfg.attn_backend == "ring" \
+                    and cfg.kv_quant != "int8":
+                sp_mesh = _active_sp_mesh()
+                if sp_mesh is not None:
+                    from lambdipy_tpu.parallel.spdecode import (
+                        sp_decode_step)
+
+                    assert s == 1, "sp decode requires one-token steps"
+                    out, nk, nv = sp_decode_step(
+                        q, k, v, cache["k"], cache["v"], idx, sp_mesh)
+                    new_cache = {"k": nk, "v": nv}
+                    sp_done = True
+            if not sp_done:
+                if cfg.kv_quant == "int8":
+                    # quantize this chunk's k/v once; the cache stays
+                    # int8 in HBM and the dequant fuses into the
+                    # attention einsum
+                    k_q, k_s = _kv_quantize(k)
+                    v_q, v_s = _kv_quantize(v)
+                    store = {"k_int8": k_q, "k_scale": k_s,
+                             "v_int8": v_q, "v_scale": v_s}
+                else:
+                    store = {"k": k, "v": v}
+                new_cache = {}
+                if jnp.ndim(idx) == 0:
+                    for name, val in store.items():
+                        new_cache[name] = jax.lax.dynamic_update_slice(
+                            cache[name], val, (0, idx, 0, 0))
+                    # chunk query j attends keys <= idx + j — causal
+                    # within the chunk, everything before it. s == 1 is
+                    # the familiar decode-step mask; s > 1 is a
+                    # multi-token continuation chunk (prefix-cache
+                    # suffix prefill).
+                    t = new_cache[next(iter(store))].shape[1]
+                    valid = (jnp.arange(t)[None, None, :]
+                             <= (idx + jnp.arange(s))[None, :, None])
+                else:
+                    # ragged batch (rows decode from different prompt
+                    # lengths): per-row scatter of this step's single
+                    # position
+                    assert s == 1, \
+                        "per-row cache indices require one-token steps"
+                    rows = jnp.arange(b)
+                    for name, val in store.items():
+                        new_cache[name] = cache[name].at[rows, idx].set(
+                            val[:, 0])
+                    t = new_cache[next(iter(store))].shape[1]
+                    valid = (jnp.arange(t)[None, None, :]
+                             <= idx[:, None, None])  # [b, 1, t]
+                new_cache = {name: shard_hint(val, "dp", None, "tp")
+                             for name, val in new_cache.items()}
+                if cfg.kv_quant == "int8":
+                    ck = _kv_dequantize(new_cache["k_int8"],
+                                        new_cache["k_scale"], cfg.dtype)
+                    cv = _kv_dequantize(new_cache["v_int8"],
+                                        new_cache["v_scale"], cfg.dtype)
+                else:
+                    ck, cv = new_cache["k"], new_cache["v"]
+                attn_mask = jnp.broadcast_to(valid, (b, s, t))
+                out = _attend(q, ck, cv, attn_mask)
 
         out = out.reshape(b, s, cfg.heads * d)
         x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, cfg.matmul_backend, name="o_proj")(out)

@@ -1,0 +1,126 @@
+"""Sequence-parallel DECODE: one-token attention over a KV cache whose
+sequence dimension is sharded across the mesh's ``sp`` axis.
+
+Ring attention (parallel/ring.py) makes long-context PREFILL scale over
+sp; this module completes the long-context serving story for the decode
+phase. Decode reads the entire cache every step — at 8B and 128k
+context that is ~16 GB of KV per batch row, past a single chip's HBM —
+so the cache must live sharded, and each step must combine per-shard
+attention partials instead of gathering keys.
+
+The TPU-native formulation (flash-decoding expressed as SPMD, not a
+hand-rolled transport):
+
+- the cache stays ``[b, T/sp, kvh, d]`` per device for the whole scan
+  (it is the dominant HBM object; it must NEVER be gathered);
+- this step's k/v (one token, replicated) is written by the OWNING
+  shard only — a masked local ``at[].set`` replaces a cross-shard
+  dynamic-update-slice the partitioner would otherwise have to gather
+  for;
+- each shard computes an online-softmax partial (local max, exp-sum,
+  weighted accumulator) over its cache block, then one
+  ``pmax`` + two ``psum`` collectives (tiny: [b, h] and [b, h, d])
+  recover exact attention. Communication per step is O(b * h * d),
+  independent of context length — the whole point.
+
+GQA grouping matches models/llama.py `_attend` (kv heads can be
+tp-sharded at the same time: the head dimension stays local to the
+shard_map body, so sp x tp compose). int8 KV (kv_quant) is dequantized
+by the caller per shard-local block before entry.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _sp_decode_local(q, k_new, v_new, ck, cv, index, *, axis_name: str,
+                     scale: float):
+    """Per-shard body. q: [b, 1, h, d] and k_new/v_new: [b, 1, kvh, d]
+    replicated over ``axis_name``; ck/cv: [b, T_local, kvh, d] local
+    cache block; index: [b] replicated write/validity position.
+    Returns (out [b, 1, h, d] replicated, updated ck, updated cv)."""
+    my = jax.lax.axis_index(axis_name)
+    b, t_loc, kvh, d = ck.shape
+    h = q.shape[2]
+    group = h // kvh
+    rows = jnp.arange(b)
+
+    # write this step's k/v on the owning shard only (per row). The
+    # non-owner "write" re-stores the OLD value at the clipped slot —
+    # selected in the small [b, kvh, d] gather, never on the cache —
+    # so the multi-GB cache block stays single-consumer and XLA can
+    # alias the scatter in place (a where() over the block would force
+    # a full copy per layer per step).
+    local_idx = index - my * t_loc  # [b]
+    owner = (local_idx >= 0) & (local_idx < t_loc)
+    clipped = jnp.clip(local_idx, 0, t_loc - 1)
+    sel = owner[:, None, None]
+    k_val = jnp.where(sel, k_new[:, 0], ck[rows, clipped])
+    v_val = jnp.where(sel, v_new[:, 0], cv[rows, clipped])
+    ck = ck.at[rows, clipped].set(k_val)
+    cv = cv.at[rows, clipped].set(v_val)
+
+    # local online-softmax partial over this shard's block
+    qg = q.reshape(b, 1, kvh, group, d)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, ck).astype(jnp.float32)
+    logits = logits * jnp.float32(scale)  # [b, kvh, g, 1, t_loc]
+    global_pos = my * t_loc + jnp.arange(t_loc)
+    valid = global_pos[None, :] <= index[:, None]  # [b, t_loc]
+    logits = jnp.where(valid[:, None, None, None, :], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)  # [b, kvh, g, 1]
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)  # [b, kvh, g, 1]
+    acc = jnp.einsum("bkgst,btkd->bskgd", p.astype(cv.dtype),
+                     cv).astype(jnp.float32)  # [b, 1, kvh, g, d]
+
+    # exact global combine: O(b*h*d) collectives, context-length-free.
+    # pmax over the RAW max (-inf sentinel on empty shards): pmax'ing
+    # m_safe would clamp the global max to >= 0 whenever ANY shard has
+    # no valid positions yet, underflowing rows whose true max logit is
+    # strongly negative. Empty shards then take a = 0 explicitly — their
+    # (zero) partials must not turn an exp overflow into NaN * 0.
+    m_g = jax.lax.pmax(m, axis_name)
+    m_g_safe = jnp.where(m_g <= NEG_INF / 2, 0.0, m_g)
+    a = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m_safe - m_g_safe))
+    l_g = jax.lax.psum(l * a, axis_name)
+    # broadcast [b, kvh, g, 1] coefficients onto [b, 1, kvh, g, d]
+    a_acc = jnp.transpose(a, (0, 3, 1, 2))[..., None]
+    acc_g = jax.lax.psum(acc * a_acc, axis_name)
+    l_g = jnp.maximum(l_g, 1e-30)
+    out = acc_g / jnp.transpose(l_g, (0, 3, 1, 2))[..., None]
+    return out.reshape(b, 1, h, d).astype(q.dtype), ck, cv
+
+
+def sp_decode_step(q, k_new, v_new, cache_k, cache_v, index, mesh: Mesh,
+                   *, axis: str = "sp", scale: float | None = None):
+    """One decode step over a sequence-sharded cache.
+
+    q: [b, 1, h, d]; k_new/v_new: [b, 1, kvh, d] (this step's
+    projections); cache_k/cache_v: [b, T, kvh, d] with T sharded over
+    ``axis``; index: [b] int32 — row r's write position (its keys
+    <= index are valid). Returns (attn_out [b, 1, h, d], new_cache_k,
+    new_cache_v) with the caches still sequence-sharded. The kv-head
+    dim additionally shards over ``tp`` when the mesh has it; batch
+    over ``dp``."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    names = mesh.axis_names
+    bax = tuple(a for a in ("dp", "fsdp") if a in names)
+    batch = bax if bax else None
+    heads = "tp" if "tp" in names else None
+    rep = P(batch, None, heads, None)           # q / k_new / v_new
+    cspec = P(batch, axis, heads, None)         # sharded cache
+    ispec = P(batch)                            # per-row index
+    local = partial(_sp_decode_local, axis_name=axis, scale=scale)
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(rep, rep, rep, cspec, cspec, ispec),
+                       out_specs=(rep, cspec, cspec))
+    return fn(q, k_new, v_new, cache_k, cache_v, index)

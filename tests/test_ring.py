@@ -124,3 +124,120 @@ def test_ring_attention_respects_padding_mask(cpu_devices):
         np.testing.assert_allclose(np.asarray(dense)[row, :n],
                                    np.asarray(ring)[row, :n],
                                    rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# sequence-parallel DECODE (parallel/spdecode.py): the long-context decode
+# path pairing with ring-attention prefill
+
+
+def test_sp_decode_step_matches_dense_reference(cpu_devices):
+    """One decode step over an sp-sharded cache == write-then-masked
+    dense attention, for ragged per-row positions, including the
+    updated cache blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models.llama import _attend
+    from lambdipy_tpu.parallel.mesh import make_mesh
+    from lambdipy_tpu.parallel.spdecode import sp_decode_step
+
+    rng = np.random.default_rng(0)
+    b, T, kvh, d, h = 3, 32, 2, 16, 8
+    mesh = make_mesh({"sp": 4}, devices=cpu_devices[:4])
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((b, 1, kvh, d)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((b, 1, kvh, d)), jnp.float32)
+    ck = jnp.asarray(rng.standard_normal((b, T, kvh, d)), jnp.float32)
+    cv = jnp.asarray(rng.standard_normal((b, T, kvh, d)), jnp.float32)
+    idx = jnp.asarray([5, 17, 31], jnp.int32)
+    with mesh:
+        out, nk, nv = jax.jit(
+            lambda *a: sp_decode_step(*a, mesh=mesh))(q, kn, vn, ck, cv,
+                                                      idx)
+    rows = jnp.arange(b)
+    rk = ck.at[rows, idx].set(kn[:, 0])
+    rv = cv.at[rows, idx].set(vn[:, 0])
+    valid = jnp.arange(T)[None, None, :] <= idx[:, None, None]
+    ref = _attend(q, rk, rv, jnp.broadcast_to(valid, (b, 1, T)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(nk), np.asarray(rk))
+    np.testing.assert_array_equal(np.asarray(nv), np.asarray(rv))
+
+
+def test_sp_serve_decode_matches_unsharded(cpu_devices):
+    """The full serving path with attn_backend='ring' over an sp mesh —
+    ring prefill + sequence-sharded flash-decoding steps — produces the
+    dense unsharded server's greedy tokens, rectangular and ragged,
+    and composes with tp."""
+    import jax
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+    from lambdipy_tpu.parallel.sharding import shard_params
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    ref_server = adapter.make_server(params)
+    ref = ref_server.generate([5, 6, 7, 8], max_new_tokens=8)
+    ref_rag = ref_server.generate([[5, 6, 7, 8], [1, 2]],
+                                  max_new_tokens=8)
+
+    ring = registry.get("llama-tiny").build(
+        extra={"attn_backend": "ring"})
+    mesh = make_mesh({"sp": 2}, devices=cpu_devices[:2])
+    with use_mesh(mesh):
+        sp_params = shard_params(params, mesh, ring.tp_rules)
+    server = ring.make_server(sp_params, mesh=mesh)
+    np.testing.assert_array_equal(
+        server.generate([5, 6, 7, 8], max_new_tokens=8), ref)
+    np.testing.assert_array_equal(
+        server.generate([[5, 6, 7, 8], [1, 2]], max_new_tokens=8),
+        ref_rag)
+
+    mesh2 = make_mesh({"sp": 2, "tp": 2}, devices=cpu_devices[:4])
+    with use_mesh(mesh2):
+        p2 = shard_params(params, mesh2, ring.tp_rules)
+    server2 = ring.make_server(p2, mesh=mesh2)
+    np.testing.assert_array_equal(
+        server2.generate([5, 6, 7, 8], max_new_tokens=8), ref)
+
+
+def test_sp_decode_strongly_negative_logits_with_empty_shards(cpu_devices):
+    """Early decode (only position 0 valid -> most shards empty) with a
+    strongly negative max logit: the combine must pmax raw maxima with
+    the -inf sentinel, not the zero-filled safe maxima — otherwise the
+    rescale underflows and the output collapses to 0/garbage."""
+    import jax
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models.llama import _attend
+    from lambdipy_tpu.parallel.mesh import make_mesh
+    from lambdipy_tpu.parallel.spdecode import sp_decode_step
+
+    b, T, kvh, d, h = 1, 8, 1, 4, 2
+    mesh = make_mesh({"sp": 4}, devices=cpu_devices[:4])
+    q = jnp.zeros((b, 1, h, d), jnp.float32).at[..., 0].set(100.0)
+    ck = jnp.zeros((b, T, kvh, d), jnp.float32)
+    cv = jnp.asarray(
+        np.arange(b * T * kvh * d, dtype=np.float32).reshape(
+            b, T, kvh, d))
+    # THIS STEP's key (written at pos 0, the only valid position) is
+    # strongly anti-aligned: the one real logit is ~ -5000, far below
+    # the 0.0 the zero-filled empty-shard maxima would clamp pmax to
+    kn = jnp.zeros((b, 1, kvh, d), jnp.float32).at[..., 0].set(-100.0)
+    vn = jnp.full((b, 1, kvh, d), 7.0, jnp.float32)
+    idx = jnp.asarray([0], jnp.int32)  # writes pos 0; only pos 0 valid
+    with mesh:
+        out, nk, nv = jax.jit(
+            lambda *a: sp_decode_step(*a, mesh=mesh))(q, kn, vn, ck, cv,
+                                                      idx)
+    rows = jnp.arange(b)
+    rk = ck.at[rows, idx].set(kn[:, 0])
+    rv = cv.at[rows, idx].set(vn[:, 0])
+    valid = jnp.arange(T)[None, None, :] <= idx[:, None, None]
+    ref = _attend(q, rk, rv, jnp.broadcast_to(valid, (b, 1, T)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    assert np.isfinite(np.asarray(out)).all()
