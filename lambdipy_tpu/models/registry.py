@@ -383,7 +383,11 @@ def _build_llama_tiny(dtype: str = "float32", quant: str | None = None,
 
     from lambdipy_tpu.models.llama import LLAMA_TINY
 
-    cfg = dataclasses.replace(LLAMA_TINY, dtype=_dtype(dtype), quant=quant)
+    # extra MUST apply (code-review r5: it was silently dropped, so every
+    # test building llama-tiny with attn_backend='ring' was vacuously
+    # exercising the dense path while claiming sp coverage)
+    cfg = dataclasses.replace(LLAMA_TINY, dtype=_dtype(dtype), quant=quant,
+                              **_llama_overrides(extra))
     return _build_llama(cfg)
 
 

@@ -166,16 +166,28 @@ def test_sp_decode_step_matches_dense_reference(cpu_devices):
     np.testing.assert_array_equal(np.asarray(nv), np.asarray(rv))
 
 
-def test_sp_serve_decode_matches_unsharded(cpu_devices):
+def test_sp_serve_decode_matches_unsharded(cpu_devices, monkeypatch):
     """The full serving path with attn_backend='ring' over an sp mesh —
     ring prefill + sequence-sharded flash-decoding steps — produces the
     dense unsharded server's greedy tokens, rectangular and ragged,
-    and composes with tp."""
+    and composes with tp. The sp path is asserted to actually TRACE
+    (code-review r5: the builder silently dropped extra and this test
+    was dense-vs-dense)."""
     import jax
 
+    import lambdipy_tpu.parallel.spdecode as spd
     from lambdipy_tpu.models import registry
     from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
     from lambdipy_tpu.parallel.sharding import shard_params
+
+    calls = {"n": 0}
+    real = spd.sp_decode_step
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(spd, "sp_decode_step", counting)
 
     adapter = registry.get("llama-tiny").build()
     params = adapter.init_params(seed=0)
@@ -183,15 +195,18 @@ def test_sp_serve_decode_matches_unsharded(cpu_devices):
     ref = ref_server.generate([5, 6, 7, 8], max_new_tokens=8)
     ref_rag = ref_server.generate([[5, 6, 7, 8], [1, 2]],
                                   max_new_tokens=8)
+    assert calls["n"] == 0  # the dense reference never touches sp
 
     ring = registry.get("llama-tiny").build(
         extra={"attn_backend": "ring"})
+    assert ring.config.attn_backend == "ring"
     mesh = make_mesh({"sp": 2}, devices=cpu_devices[:2])
     with use_mesh(mesh):
         sp_params = shard_params(params, mesh, ring.tp_rules)
     server = ring.make_server(sp_params, mesh=mesh)
     np.testing.assert_array_equal(
         server.generate([5, 6, 7, 8], max_new_tokens=8), ref)
+    assert calls["n"] > 0, "sp decode path never traced"
     np.testing.assert_array_equal(
         server.generate([[5, 6, 7, 8], [1, 2]], max_new_tokens=8),
         ref_rag)
