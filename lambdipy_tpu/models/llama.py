@@ -300,20 +300,27 @@ class LlamaBlock(nn.Module):
             # whole scan and each step combines per-shard online-softmax
             # partials with O(b*h*d) collectives — the long-context
             # decode path, pairing with ring-attention prefill
-            # (parallel/spdecode.py). float KV only; int8-KV falls
-            # through to the replicated path.
+            # (parallel/spdecode.py). Composes with kv_quant: the int8
+            # cache leaves shard the same way and the per-shard dequant
+            # fuses into the local attention einsum.
             sp_done = False
-            if jnp.ndim(idx) != 0 and cfg.attn_backend == "ring" \
-                    and cfg.kv_quant != "int8":
+            if jnp.ndim(idx) != 0 and cfg.attn_backend == "ring":
                 sp_mesh = _active_sp_mesh()
                 if sp_mesh is not None:
                     from lambdipy_tpu.parallel.spdecode import (
                         sp_decode_step)
 
                     assert s == 1, "sp decode requires one-token steps"
-                    out, nk, nv = sp_decode_step(
-                        q, k, v, cache["k"], cache["v"], idx, sp_mesh)
-                    new_cache = {"k": nk, "v": nv}
+                    if cfg.kv_quant == "int8":
+                        k_q, k_s = _kv_quantize(k)
+                        v_q, v_s = _kv_quantize(v)
+                        sp_new = {"k_int8": k_q, "k_scale": k_s,
+                                  "v_int8": v_q, "v_scale": v_s}
+                    else:
+                        sp_new = {"k": k, "v": v}
+                    sp_cache = {name: cache[name] for name in sp_new}
+                    out, new_cache = sp_decode_step(
+                        q, sp_new, sp_cache, idx, sp_mesh)
                     sp_done = True
             if not sp_done:
                 if cfg.kv_quant == "int8":

@@ -367,12 +367,13 @@ def _build_llama_moe_tiny(dtype: str = "float32", quant: str | None = None,
 
     from lambdipy_tpu.models.llama import LLAMA_TINY
 
-    extra = extra or {}
-    cfg = dataclasses.replace(
-        LLAMA_TINY, dtype=_dtype(dtype), quant=quant,
-        moe_experts=int(extra.get("moe_experts", 4)),
-        moe_top_k=int(extra.get("moe_top_k", 2)),
-        moe_capacity_factor=float(extra.get("moe_capacity_factor", 1.25)))
+    # every extra key applies through the shared validator (the same
+    # silently-dropped-extra bug class _build_llama_tiny had); only the
+    # MoE-enabling default differs from LlamaConfig's
+    extra = dict(extra or {})
+    extra.setdefault("moe_experts", 4)
+    cfg = dataclasses.replace(LLAMA_TINY, dtype=_dtype(dtype), quant=quant,
+                              **_llama_overrides(extra))
     return _build_llama(cfg)
 
 

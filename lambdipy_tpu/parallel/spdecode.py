@@ -40,32 +40,48 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _sp_decode_local(q, k_new, v_new, ck, cv, index, *, axis_name: str,
-                     scale: float):
-    """Per-shard body. q: [b, 1, h, d] and k_new/v_new: [b, 1, kvh, d]
-    replicated over ``axis_name``; ck/cv: [b, T_local, kvh, d] local
-    cache block; index: [b] replicated write/validity position.
-    Returns (out [b, 1, h, d] replicated, updated ck, updated cv)."""
-    my = jax.lax.axis_index(axis_name)
-    b, t_loc, kvh, d = ck.shape
-    h = q.shape[2]
-    group = h // kvh
+def _owner_write(leaf, new_row, my, t_loc, index):
+    """Write ``new_row`` [b, kvh, ...] at each row's position on the
+    owning shard only. The non-owner "write" re-stores the OLD value at
+    the clipped slot — selected in the small per-row gather, never on
+    the cache — so the multi-GB cache block stays single-consumer and
+    XLA can alias the scatter in place (a where() over the block would
+    force a full copy per layer per step)."""
+    b = leaf.shape[0]
     rows = jnp.arange(b)
-
-    # write this step's k/v on the owning shard only (per row). The
-    # non-owner "write" re-stores the OLD value at the clipped slot —
-    # selected in the small [b, kvh, d] gather, never on the cache —
-    # so the multi-GB cache block stays single-consumer and XLA can
-    # alias the scatter in place (a where() over the block would force
-    # a full copy per layer per step).
     local_idx = index - my * t_loc  # [b]
     owner = (local_idx >= 0) & (local_idx < t_loc)
     clipped = jnp.clip(local_idx, 0, t_loc - 1)
-    sel = owner[:, None, None]
-    k_val = jnp.where(sel, k_new[:, 0], ck[rows, clipped])
-    v_val = jnp.where(sel, v_new[:, 0], cv[rows, clipped])
-    ck = ck.at[rows, clipped].set(k_val)
-    cv = cv.at[rows, clipped].set(v_val)
+    sel = owner.reshape((b,) + (1,) * (new_row.ndim - 1))
+    val = jnp.where(sel, new_row, leaf[rows, clipped])
+    return leaf.at[rows, clipped].set(val)
+
+
+def _sp_decode_local(q, store_new, cache, index, *, axis_name: str,
+                     scale: float, quant: bool):
+    """Per-shard body. q: [b, 1, h, d] replicated over ``axis_name``;
+    ``store_new``: this step's projections ([b, 1, kvh, ...] leaves —
+    k/v, or int8 values + scales under ``quant``) replicated;
+    ``cache``: the matching [b, T_local, kvh, ...] local cache blocks;
+    index: [b] replicated write/validity position. Returns
+    (out [b, 1, h, d] replicated, updated cache dict)."""
+    my = jax.lax.axis_index(axis_name)
+    first = next(iter(cache.values()))
+    b, t_loc = first.shape[0], first.shape[1]
+    kvh = first.shape[2]
+    h, d = q.shape[2], q.shape[3]
+    group = h // kvh
+
+    cache = {name: _owner_write(cache[name], store_new[name][:, 0], my,
+                                t_loc, index)
+             for name in cache}
+    if quant:
+        ck = (cache["k_int8"].astype(q.dtype)
+              * cache["k_scale"].astype(q.dtype))
+        cv = (cache["v_int8"].astype(q.dtype)
+              * cache["v_scale"].astype(q.dtype))
+    else:
+        ck, cv = cache["k"], cache["v"]
 
     # local online-softmax partial over this shard's block
     qg = q.reshape(b, 1, kvh, group, d)
@@ -97,30 +113,39 @@ def _sp_decode_local(q, k_new, v_new, ck, cv, index, *, axis_name: str,
     acc_g = jax.lax.psum(acc * a_acc, axis_name)
     l_g = jnp.maximum(l_g, 1e-30)
     out = acc_g / jnp.transpose(l_g, (0, 3, 1, 2))[..., None]
-    return out.reshape(b, 1, h, d).astype(q.dtype), ck, cv
+    return out.reshape(b, 1, h, d).astype(q.dtype), cache
 
 
-def sp_decode_step(q, k_new, v_new, cache_k, cache_v, index, mesh: Mesh,
+def sp_decode_step(q, store_new: dict, cache: dict, index, mesh: Mesh,
                    *, axis: str = "sp", scale: float | None = None):
     """One decode step over a sequence-sharded cache.
 
-    q: [b, 1, h, d]; k_new/v_new: [b, 1, kvh, d] (this step's
-    projections); cache_k/cache_v: [b, T, kvh, d] with T sharded over
+    q: [b, 1, h, d]; ``store_new``: this step's projections as a dict
+    of [b, 1, kvh, ...] leaves — ``{"k", "v"}`` for a float cache, or
+    ``{"k_int8", "k_scale", "v_int8", "v_scale"}`` for an int8-KV
+    cache (quantized by the caller per vector; the per-shard dequant
+    fuses into the local attention einsum, so int8 halves the SHARDED
+    cache's HBM and read traffic exactly like the replicated path);
+    ``cache``: the matching [b, T, kvh, ...] leaves with T sharded over
     ``axis``; index: [b] int32 — row r's write position (its keys
-    <= index are valid). Returns (attn_out [b, 1, h, d], new_cache_k,
-    new_cache_v) with the caches still sequence-sharded. The kv-head
-    dim additionally shards over ``tp`` when the mesh has it; batch
-    over ``dp``."""
+    <= index are valid). Returns (attn_out [b, 1, h, d], new cache
+    dict) with the cache still sequence-sharded. The kv-head dim
+    additionally shards over ``tp`` when the mesh has it; batch over
+    ``dp``."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     names = mesh.axis_names
     bax = tuple(a for a in ("dp", "fsdp") if a in names)
     batch = bax if bax else None
     heads = "tp" if "tp" in names else None
-    rep = P(batch, None, heads, None)           # q / k_new / v_new
-    cspec = P(batch, axis, heads, None)         # sharded cache
+    rep = P(batch, None, heads, None)           # q and store_new leaves
+    cspec = P(batch, axis, heads, None)         # sharded cache leaves
     ispec = P(batch)                            # per-row index
-    local = partial(_sp_decode_local, axis_name=axis, scale=scale)
-    fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(rep, rep, rep, cspec, cspec, ispec),
-                       out_specs=(rep, cspec, cspec))
-    return fn(q, k_new, v_new, cache_k, cache_v, index)
+    quant = "k_int8" in cache
+    local = partial(_sp_decode_local, axis_name=axis, scale=scale,
+                    quant=quant)
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(rep, {name: rep for name in store_new},
+                  {name: cspec for name in cache}, ispec),
+        out_specs=(rep, {name: cspec for name in cache}))
+    return fn(q, store_new, cache, index)

@@ -50,3 +50,21 @@ def cpu_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"
     return devices
+
+
+@pytest.fixture()
+def count_sp_decode(monkeypatch):
+    """Counts sp_decode_step TRACES so sp-path tests can assert the
+    sequence-parallel decode actually ran (code-review r5: a silently
+    dropped backend override once made those tests dense-vs-dense)."""
+    import lambdipy_tpu.parallel.spdecode as spd
+
+    calls = {"n": 0}
+    real = spd.sp_decode_step
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(spd, "sp_decode_step", counting)
+    return calls

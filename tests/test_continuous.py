@@ -438,25 +438,17 @@ def test_engine_over_tp_sharded_server(cpu_devices):
     assert stats["rows_in_segments"] > stats["segments_run"], stats
 
 
-def test_engine_over_sp_mesh_long_context_path(cpu_devices, monkeypatch):
+def test_engine_over_sp_mesh_long_context_path(cpu_devices, count_sp_decode):
     """Continuous batching over the LONG-CONTEXT serving shape
     (attn_backend='ring' + sp mesh): engine-packed rows decode through
     sequence-sharded sp_decode steps (asserted to trace — code-review
     r5 caught the vacuous dense-vs-dense version) and match the dense
     unsharded solo outputs."""
-    import lambdipy_tpu.parallel.spdecode as spd
     from lambdipy_tpu.models import registry
     from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
     from lambdipy_tpu.parallel.sharding import shard_params
 
-    calls = {"n": 0}
-    real = spd.sp_decode_step
-
-    def counting(*a, **kw):
-        calls["n"] += 1
-        return real(*a, **kw)
-
-    monkeypatch.setattr(spd, "sp_decode_step", counting)
+    calls = count_sp_decode
 
     adapter = registry.get("llama-tiny").build()
     params = adapter.init_params(seed=0)

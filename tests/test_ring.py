@@ -152,9 +152,9 @@ def test_sp_decode_step_matches_dense_reference(cpu_devices):
     cv = jnp.asarray(rng.standard_normal((b, T, kvh, d)), jnp.float32)
     idx = jnp.asarray([5, 17, 31], jnp.int32)
     with mesh:
-        out, nk, nv = jax.jit(
-            lambda *a: sp_decode_step(*a, mesh=mesh))(q, kn, vn, ck, cv,
-                                                      idx)
+        out, ncache = jax.jit(
+            lambda *a: sp_decode_step(*a, mesh=mesh))(
+            q, {"k": kn, "v": vn}, {"k": ck, "v": cv}, idx)
     rows = jnp.arange(b)
     rk = ck.at[rows, idx].set(kn[:, 0])
     rv = cv.at[rows, idx].set(vn[:, 0])
@@ -162,11 +162,11 @@ def test_sp_decode_step_matches_dense_reference(cpu_devices):
     ref = _attend(q, rk, rv, jnp.broadcast_to(valid, (b, 1, T)))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
-    np.testing.assert_array_equal(np.asarray(nk), np.asarray(rk))
-    np.testing.assert_array_equal(np.asarray(nv), np.asarray(rv))
+    np.testing.assert_array_equal(np.asarray(ncache["k"]), np.asarray(rk))
+    np.testing.assert_array_equal(np.asarray(ncache["v"]), np.asarray(rv))
 
 
-def test_sp_serve_decode_matches_unsharded(cpu_devices, monkeypatch):
+def test_sp_serve_decode_matches_unsharded(cpu_devices, count_sp_decode):
     """The full serving path with attn_backend='ring' over an sp mesh —
     ring prefill + sequence-sharded flash-decoding steps — produces the
     dense unsharded server's greedy tokens, rectangular and ragged,
@@ -175,19 +175,11 @@ def test_sp_serve_decode_matches_unsharded(cpu_devices, monkeypatch):
     was dense-vs-dense)."""
     import jax
 
-    import lambdipy_tpu.parallel.spdecode as spd
     from lambdipy_tpu.models import registry
     from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
     from lambdipy_tpu.parallel.sharding import shard_params
 
-    calls = {"n": 0}
-    real = spd.sp_decode_step
-
-    def counting(*a, **kw):
-        calls["n"] += 1
-        return real(*a, **kw)
-
-    monkeypatch.setattr(spd, "sp_decode_step", counting)
+    calls = count_sp_decode
 
     adapter = registry.get("llama-tiny").build()
     params = adapter.init_params(seed=0)
@@ -245,9 +237,9 @@ def test_sp_decode_strongly_negative_logits_with_empty_shards(cpu_devices):
     vn = jnp.full((b, 1, kvh, d), 7.0, jnp.float32)
     idx = jnp.asarray([0], jnp.int32)  # writes pos 0; only pos 0 valid
     with mesh:
-        out, nk, nv = jax.jit(
-            lambda *a: sp_decode_step(*a, mesh=mesh))(q, kn, vn, ck, cv,
-                                                      idx)
+        out, _ = jax.jit(
+            lambda *a: sp_decode_step(*a, mesh=mesh))(
+            q, {"k": kn, "v": vn}, {"k": ck, "v": cv}, idx)
     rows = jnp.arange(b)
     rk = ck.at[rows, idx].set(kn[:, 0])
     rv = cv.at[rows, idx].set(vn[:, 0])
@@ -256,3 +248,35 @@ def test_sp_decode_strongly_negative_logits_with_empty_shards(cpu_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_sp_decode_int8_kv_matches_replicated_int8(cpu_devices, count_sp_decode):
+    """kv_quant='int8' composes with sp decode: the int8 cache leaves
+    shard over sp, the sp path traces, and serve outputs match the
+    REPLICATED int8-KV server (same quantization, different reduction
+    layout)."""
+    import dataclasses
+
+    import jax
+
+    from lambdipy_tpu.models.llama import (LLAMA_TINY, LlamaModel,
+                                           LlamaServer)
+    from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+
+    calls = count_sp_decode
+
+    cfg = dataclasses.replace(LLAMA_TINY, kv_quant="int8")
+    module = LlamaModel(cfg)
+    tokens = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tokens)
+    ref = LlamaServer(module, params).generate([5, 6, 7, 8],
+                                               max_new_tokens=8)
+    assert calls["n"] == 0
+
+    ring_cfg = dataclasses.replace(cfg, attn_backend="ring")
+    mesh = make_mesh({"sp": 2}, devices=cpu_devices[:2])
+    # params replicated; the server enters the mesh itself
+    server = LlamaServer(LlamaModel(ring_cfg), params, mesh=mesh)
+    out = server.generate([5, 6, 7, 8], max_new_tokens=8)
+    assert calls["n"] > 0, "int8 sp decode never traced"
+    np.testing.assert_array_equal(out, ref)
