@@ -199,6 +199,19 @@ def _kv_dequantize(q_i8, scale, dtype):
     return q_i8.astype(dtype) * scale.astype(dtype)
 
 
+def _kv_store(cfg, k, v) -> dict:
+    """This step's (or chunk's) K/V in the cache's storage layout: the
+    float leaves, or int8 values + scales under ``cfg.kv_quant``. The
+    ONE place the layout is built — the dense decode path, the sp
+    decode path, and prefill embedding all consume it."""
+    if cfg.kv_quant == "int8":
+        k_q, k_s = _kv_quantize(k)
+        v_q, v_s = _kv_quantize(v)
+        return {"k_int8": k_q, "k_scale": k_s,
+                "v_int8": v_q, "v_scale": v_s}
+    return {"k": k.astype(cfg.dtype), "v": v.astype(cfg.dtype)}
+
+
 def _active_sp_mesh():
     """The ambient mesh when sequence parallelism is usable: an ``sp``
     axis > 1 and not inside a manual (shard_map / pipeline-stage) region
@@ -311,28 +324,16 @@ class LlamaBlock(nn.Module):
                         sp_decode_step)
 
                     assert s == 1, "sp decode requires one-token steps"
-                    if cfg.kv_quant == "int8":
-                        k_q, k_s = _kv_quantize(k)
-                        v_q, v_s = _kv_quantize(v)
-                        sp_new = {"k_int8": k_q, "k_scale": k_s,
-                                  "v_int8": v_q, "v_scale": v_s}
-                    else:
-                        sp_new = {"k": k, "v": v}
+                    sp_new = _kv_store(cfg, k, v)
                     sp_cache = {name: cache[name] for name in sp_new}
                     out, new_cache = sp_decode_step(
                         q, sp_new, sp_cache, idx, sp_mesh)
                     sp_done = True
             if not sp_done:
-                if cfg.kv_quant == "int8":
-                    # quantize this chunk's k/v once; the cache stays
-                    # int8 in HBM and the dequant fuses into the
-                    # attention einsum
-                    k_q, k_s = _kv_quantize(k)
-                    v_q, v_s = _kv_quantize(v)
-                    store = {"k_int8": k_q, "k_scale": k_s,
-                             "v_int8": v_q, "v_scale": v_s}
-                else:
-                    store = {"k": k, "v": v}
+                # quantize this chunk's k/v once under kv_quant; the
+                # cache stays int8 in HBM and the dequant fuses into
+                # the attention einsum
+                store = _kv_store(cfg, k, v)
                 new_cache = {}
                 if jnp.ndim(idx) == 0:
                     for name, val in store.items():
@@ -451,14 +452,7 @@ def prefill_into_cache(cfg: LlamaConfig, prefill_cache, batch: int, max_len: int
     static max_len decode cache (quantizing when cfg.kv_quant)."""
     out = []
     for entry in prefill_cache:
-        if cfg.kv_quant == "int8":
-            k_q, k_s = _kv_quantize(entry["k"])
-            v_q, v_s = _kv_quantize(entry["v"])
-            store = {"k_int8": k_q, "k_scale": k_s,
-                     "v_int8": v_q, "v_scale": v_s}
-        else:
-            store = {"k": entry["k"].astype(cfg.dtype),
-                     "v": entry["v"].astype(cfg.dtype)}
+        store = _kv_store(cfg, entry["k"], entry["v"])
         dest = _empty_cache_entry(cfg, batch, max_len)
         for name, val in store.items():
             dest[name] = jax.lax.dynamic_update_slice(
@@ -1737,29 +1731,51 @@ class LlamaServer:
         return self._fn_cached(("spec", kb, cache_len), build)
 
     def _spec_steps(self, rows, max_new_tokens: int, kb: int, eos_id,
-                    ngram_max: int, stats_out: dict):
+                    ngram_max: int, stats_out: dict, prefix=None,
+                    prefix_entry=None):
         """The speculative verify loop as a per-step generator: yields
         ``(tokens, logprobs)`` LISTS per verify step (1..kb tokens each —
         the accepted draft prefix plus the corrected token), filling
         ``stats_out`` with the acceptance counters as it goes. Both the
         fused :meth:`generate_speculative` and the streaming
         :meth:`generate_speculative_stream` consume this one loop, so
-        their emitted tokens agree by construction."""
+        their emitted tokens agree by construction. With ``prefix`` the
+        initial carry comes from the cached prefix KV's continuation
+        program (only the suffix prefills; the prefix tokens still feed
+        the lookup-draft context — a shared system prompt is prime
+        n-gram material)."""
         cfg = self.model.cfg
         s = len(rows[0])
         cache_len = cfg.max_len
-        sb = min(_next_bucket(s, self.min_bucket), cache_len)
-        # prefill keyed at the streaming default segment: the prefill
-        # program does not depend on the segment size, so every k (and
-        # the streaming path itself) shares ONE compiled prefill per
-        # bucket instead of compiling a byte-identical copy per k
-        prefill, _ = self._stream_fns(1, sb, cache_len, 16)
-        vf = self._spec_verify_fn(kb, cache_len)
-        prompt_op, length_op = self._pad_rows(rows, [s], 1, sb)
         knobs = self._knob_operands(0.0, None, None, 0, None)
         with self._mesh_ctx():
-            tok, lp0, cache, _pos, _done, _rng = prefill(
-                self.params, prompt_op, length_op, *knobs)
+            if prefix is not None:
+                # the caller already fetched the entry for validation —
+                # don't re-hash the (possibly long) prefix per request
+                pcache, plen = (prefix_entry if prefix_entry is not None
+                                else self._prefix_entry(prefix))
+                sbs = min(_next_bucket(s, self.min_bucket),
+                          cfg.max_len - plen)
+                cont = self._stream_prefix_fn(sbs)
+                suffix_op, _ = self._pad_rows(rows, [s], 1, sbs)
+                tok, lp0, cache, _pos, _done, _rng = cont(
+                    self.params, pcache, suffix_op, jnp.int32(s), *knobs)
+                context0 = [int(t) for t in
+                            jnp.asarray(prefix).reshape(-1).tolist()] \
+                    + list(map(int, rows[0]))
+            else:
+                sb = min(_next_bucket(s, self.min_bucket), cache_len)
+                # prefill keyed at the streaming default segment: the
+                # prefill program does not depend on the segment size,
+                # so every k (and the streaming path itself) shares ONE
+                # compiled prefill per bucket instead of compiling a
+                # byte-identical copy per k
+                prefill, _ = self._stream_fns(1, sb, cache_len, 16)
+                prompt_op, length_op = self._pad_rows(rows, [s], 1, sb)
+                tok, lp0, cache, _pos, _done, _rng = prefill(
+                    self.params, prompt_op, length_op, *knobs)
+                context0 = list(map(int, rows[0]))
+        vf = self._spec_verify_fn(kb, cache_len)
         # normalize the prefill cache's per-row (1,) index to the scalar
         # the verify fn itself writes: without this the first vf call
         # traces a second shape variant, doubling the (multi-second
@@ -1769,7 +1785,7 @@ class LlamaServer:
             float(x) for x in jax.device_get((tok[0], lp0[0])))
         pending = int(pending)
         emitted = 0
-        context = list(map(int, rows[0]))
+        context = context0
         generated: list[int] = []
         steps = 0
         while emitted < max_new_tokens:
@@ -1789,7 +1805,7 @@ class LlamaServer:
             generated.extend(toks_step)
             pending, pending_lp = int(new_h[0]), float(lp_h[cnt - 1])
             tok = new_tok
-            context = context[:s] + generated
+            context = context0 + generated
             stats_out.update(
                 {"steps": steps, "emitted": emitted,
                  "tokens_per_step": round(emitted / max(1, steps), 2),
@@ -1803,6 +1819,7 @@ class LlamaServer:
                                     eos_id: int | None = None,
                                     return_logprobs: bool = False,
                                     ngram_max: int = 3,
+                                    prefix=None,
                                     stats_out: dict | None = None):
         """Streaming speculative decode (VERDICT r5 weak #2 composition):
         each verify step's ACCEPTED chunk is a stream segment, so
@@ -1822,10 +1839,15 @@ class LlamaServer:
         if len(rows) != 1:
             raise ValueError("speculative decoding is single-row")
         s = lengths[0]
-        self._validate(s, max_new_tokens)
+        plen, pentry = 0, None
+        if prefix is not None:
+            pentry = self._prefix_entry(prefix)
+            plen = pentry[1]
+        self._validate(plen + s, max_new_tokens)
         kb = max(2, _next_bucket(max(2, int(k)), 2))
         stats = {} if stats_out is None else stats_out
-        if max_new_tokens == 0 or s + max_new_tokens + kb > cfg.max_len:
+        if max_new_tokens == 0 or \
+                plen + s + max_new_tokens + kb > cfg.max_len:
             # no room for a full verify chunk near the context boundary:
             # stream plain decode instead (same fallback as the fused
             # path, segment-bounded TTFT)
@@ -1834,11 +1856,12 @@ class LlamaServer:
                           "tokens_per_step": 1.0, "k": kb})
             yield from self.generate_stream(
                 rows[0], max_new_tokens=max_new_tokens, eos_id=eos_id,
-                return_logprobs=return_logprobs)
+                prefix=prefix, return_logprobs=return_logprobs)
             return
         emitted = 0
         for toks_step, lps_step in self._spec_steps(
-                rows, max_new_tokens, kb, eos_id, ngram_max, stats):
+                rows, max_new_tokens, kb, eos_id, ngram_max, stats,
+                prefix=prefix, prefix_entry=pentry):
             take = min(len(toks_step), max_new_tokens - emitted)
             if take <= 0:
                 return
@@ -1860,7 +1883,7 @@ class LlamaServer:
                              k: int = 8, eos_id: int | None = None,
                              return_logprobs: bool = False,
                              return_stats: bool = False,
-                             ngram_max: int = 3):
+                             ngram_max: int = 3, prefix=None):
         """Greedy decode with prompt-lookup speculative verification
         (single row). In exact arithmetic the output is BITWISE
         :meth:`generate`'s greedy output — speculation only changes how
@@ -1883,12 +1906,17 @@ class LlamaServer:
         if len(rows) != 1:
             raise ValueError("speculative decoding is single-row")
         s = lengths[0]
-        self._validate(s, max_new_tokens)
+        plen, pentry = 0, None
+        if prefix is not None:
+            pentry = self._prefix_entry(prefix)
+            plen = pentry[1]
+        self._validate(plen + s, max_new_tokens)
         kb = max(2, _next_bucket(max(2, int(k)), 2))
-        if max_new_tokens == 0 or s + max_new_tokens + kb > cfg.max_len:
+        if max_new_tokens == 0 or \
+                plen + s + max_new_tokens + kb > cfg.max_len:
             # no room for a full verify chunk near the context boundary
             out = self.generate(rows[0], max_new_tokens=max_new_tokens,
-                                eos_id=eos_id,
+                                eos_id=eos_id, prefix=prefix,
                                 return_logprobs=return_logprobs)
             stats = {"fallback": "plain", "steps": max_new_tokens,
                      "emitted": max_new_tokens, "tokens_per_step": 1.0,
@@ -1899,7 +1927,8 @@ class LlamaServer:
         lps: list[float] = []
         stats: dict = {}
         for toks_step, lps_step in self._spec_steps(
-                rows, max_new_tokens, kb, eos_id, ngram_max, stats):
+                rows, max_new_tokens, kb, eos_id, ngram_max, stats,
+                prefix=prefix, prefix_entry=pentry):
             emitted.extend(toks_step)
             lps.extend(lps_step)
         # kept as a convenience for single-threaded callers/tests; the

@@ -351,8 +351,6 @@ def _build_llama_hf(dtype: str = "bfloat16", quant: str | None = None,
     """Serve an HF-imported checkpoint: every architecture field comes from
     ``extra`` (recorded in the bundle manifest by models/convert.py), so
     the module exactly matches the converted weights."""
-    import dataclasses
-
     from lambdipy_tpu.models.llama import LlamaConfig
 
     cfg = LlamaConfig(dtype=_dtype(dtype), quant=quant,

@@ -578,10 +578,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 return {"ok": False, "error":
                         "speculative decoding is greedy-only (send "
                         "temperature 0)"}
-            if len(prompt) != 1 or prefix is not None:
+            if len(prompt) != 1:
                 return {"ok": False, "error":
-                        "speculative decoding is single-row without "
-                        "prefix"}
+                        "speculative decoding is single-row"}
         return (prompt, max_new, sample_kwargs, from_text, prefix,
                 bool(req.get("logprobs")), spec_k)
 
@@ -622,8 +621,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # threaded server and go stale on the fallback path.
             out_, spec_stats = server.generate_speculative(
                 prompt, max_new_tokens=max_new, k=spec_k,
-                eos_id=sample_kwargs["eos_id"], return_logprobs=want_lp,
-                return_stats=True)
+                eos_id=sample_kwargs["eos_id"], prefix=prefix,
+                return_logprobs=want_lp, return_stats=True)
             toks, lps = out_ if want_lp else (out_, None)
         elif prefix is not None:
             # shared-prefix KV reuse: only the suffix prefills per
@@ -694,8 +693,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             spec_stats = {}
             chunks_iter = server.generate_speculative_stream(
                 prompt[0], max_new_tokens=max_new, k=spec_k,
-                eos_id=sample_kwargs["eos_id"], return_logprobs=want_lp,
-                stats_out=spec_stats)
+                eos_id=sample_kwargs["eos_id"], prefix=prefix,
+                return_logprobs=want_lp, stats_out=spec_stats)
         elif continuous is not None and len(prompt) == 1:
             # under continuous batching a streamed single-row request
             # joins the shared engine batch and receives its slice per

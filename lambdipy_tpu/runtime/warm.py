@@ -36,8 +36,6 @@ def warm_bundle(bundle_dir: Path) -> dict:
 
 
 def main(argv=None) -> int:
-    import os
-
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
         print("usage: warm <bundle_dir>", file=sys.stderr)

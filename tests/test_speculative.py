@@ -210,3 +210,60 @@ def test_speculative_stream_matches_fused(tiny_server):
         [5, 6, 7, 8], max_new_tokens=10, k=4, eos_id=eos)), axis=1)
     np.testing.assert_array_equal(got, ref[:, :got.shape[1]])
     assert got[0, -1] == eos
+
+
+def test_speculative_composes_with_prefix(tiny_server):
+    """Speculative decoding from a cached prefix KV (system prompt +
+    greedy speculation): only the suffix prefills, the prefix tokens
+    still feed the lookup drafts, and the output is bitwise the
+    full-prompt speculative (== plain greedy) output, fused and
+    streamed, with logprobs riding."""
+    prefix, suffix = list(range(1, 20)), [4, 5]
+    full = tiny_server.generate_speculative(prefix + suffix,
+                                            max_new_tokens=16, k=4)
+    via, stats = tiny_server.generate_speculative(
+        suffix, max_new_tokens=16, k=4, prefix=prefix, return_stats=True)
+    np.testing.assert_array_equal(via, full)
+    np.testing.assert_array_equal(
+        via, tiny_server.generate(prefix + suffix, max_new_tokens=16))
+    assert stats["steps"] >= 1
+    st = np.concatenate(list(tiny_server.generate_speculative_stream(
+        suffix, max_new_tokens=16, k=4, prefix=prefix)), axis=1)
+    np.testing.assert_array_equal(st, full[:, : st.shape[1]])
+    ft, fl = tiny_server.generate_speculative(
+        suffix, max_new_tokens=12, k=4, prefix=prefix,
+        return_logprobs=True)
+    rt, rl = tiny_server.generate_speculative(
+        prefix + suffix, max_new_tokens=12, k=4, return_logprobs=True)
+    np.testing.assert_array_equal(ft, rt)
+    np.testing.assert_allclose(fl, rl, rtol=1e-4, atol=1e-4)
+
+
+def test_handler_speculative_with_prefix(tmp_path):
+    """`"speculative": k` + `"prefix": [...]` through /invoke and the
+    stream path: tokens match the concatenated-prompt speculative
+    request, with prefix_cached and the counters on the response."""
+    from tests.test_runtime import make_model_bundle
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "12"})
+    report = load_bundle(bundle, warmup=False)
+    full = report.handler.invoke(
+        report.state, {"tokens": list(range(1, 20)) + [4, 5],
+                       "speculative": 4})
+    via = report.handler.invoke(
+        report.state, {"tokens": [4, 5], "prefix": list(range(1, 20)),
+                       "speculative": 4})
+    assert via["ok"], via
+    assert via["tokens"] == full["tokens"]
+    assert via["prefix_cached"] and via["speculative"]["steps"] >= 1
+    chunks = list(report.state.invoke_stream(
+        {"tokens": [4, 5], "prefix": list(range(1, 20)),
+         "speculative": 4, "stream": True}))
+    streamed = [t for c in chunks if c.get("tokens")
+                for t in c["tokens"][0]]
+    assert streamed == full["tokens"][0][:len(streamed)]
+    assert chunks[-1].get("prefix_cached")
