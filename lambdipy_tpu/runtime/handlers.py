@@ -574,10 +574,6 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 return {"ok": False, "error":
                         "speculative decoding needs the compile-once "
                         "server"}
-            if sample_kwargs["temperature"] > 0.0:
-                return {"ok": False, "error":
-                        "speculative decoding is greedy-only (send "
-                        "temperature 0)"}
             if len(prompt) != 1:
                 return {"ok": False, "error":
                         "speculative decoding is single-row"}
@@ -614,15 +610,17 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                     "error": "logprobs need the compile-once server"}
         spec_stats = None
         if spec_k is not None:
-            # greedy speculative decoding: prompt-lookup drafts verified
-            # in chunks — plain greedy output, fewer weight reads
-            # (models/llama.py generate_speculative). Stats come back
-            # with the call: instance state would race under the
-            # threaded server and go stale on the fallback path.
+            # speculative decoding: prompt-lookup drafts verified in
+            # chunks — plain greedy output at temperature 0, exact
+            # rejection-sampled output (seed-deterministic) above it —
+            # fewer weight reads either way (models/llama.py
+            # generate_speculative). Stats come back with the call:
+            # instance state would race under the threaded server and
+            # go stale on the fallback path.
             out_, spec_stats = server.generate_speculative(
-                prompt, max_new_tokens=max_new, k=spec_k,
-                eos_id=sample_kwargs["eos_id"], prefix=prefix,
-                return_logprobs=want_lp, return_stats=True)
+                prompt, max_new_tokens=max_new, k=spec_k, prefix=prefix,
+                return_logprobs=want_lp, return_stats=True,
+                **sample_kwargs)
             toks, lps = out_ if want_lp else (out_, None)
         elif prefix is not None:
             # shared-prefix KV reuse: only the suffix prefills per
@@ -693,8 +691,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             spec_stats = {}
             chunks_iter = server.generate_speculative_stream(
                 prompt[0], max_new_tokens=max_new, k=spec_k,
-                eos_id=sample_kwargs["eos_id"], prefix=prefix,
-                return_logprobs=want_lp, stats_out=spec_stats)
+                prefix=prefix, return_logprobs=want_lp,
+                stats_out=spec_stats, **sample_kwargs)
         elif continuous is not None and len(prompt) == 1:
             # under continuous batching a streamed single-row request
             # joins the shared engine batch and receives its slice per

@@ -104,10 +104,14 @@ def test_handler_speculative_knob(tmp_path):
     assert spec["ok"], spec
     assert spec["tokens"] == plain["tokens"]
     assert spec["speculative"]["emitted"] >= 16
-    bad = report.handler.invoke(report.state,
-                                {"tokens": [1, 2], "speculative": 4,
-                                 "temperature": 0.7})
-    assert not bad["ok"] and "greedy-only" in bad["error"]
+    sampled = report.handler.invoke(report.state,
+                                    {"tokens": [1, 2], "speculative": 4,
+                                     "temperature": 0.7, "seed": 5})
+    again = report.handler.invoke(report.state,
+                                  {"tokens": [1, 2], "speculative": 4,
+                                   "temperature": 0.7, "seed": 5})
+    assert sampled["ok"] and sampled["tokens"] == again["tokens"]
+    assert sampled["speculative"]["steps"] >= 1
     bad2 = report.handler.invoke(report.state,
                                  {"tokens": [[1, 2], [3, 4]],
                                   "speculative": 4})
@@ -267,3 +271,64 @@ def test_handler_speculative_with_prefix(tmp_path):
                 for t in c["tokens"][0]]
     assert streamed == full["tokens"][0][:len(streamed)]
     assert chunks[-1].get("prefix_cached")
+
+
+def test_spec_accept_resample_is_exactly_target_distributed():
+    """The delta-proposal rejection core's identity, checked empirically:
+    over many keys, the first emitted token's distribution equals the
+    target row distribution (accept d0 w.p. p0(d0), else resample from
+    the residual)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models.llama import _spec_accept_resample
+
+    rng = np.random.default_rng(0)
+    v, kb = 8, 4
+    logits = rng.standard_normal((kb, v)) * 1.5
+    probs = jnp.asarray(
+        np.exp(logits) / np.exp(logits).sum(-1, keepdims=True),
+        jnp.float32)
+    draft = jnp.asarray([2, 5, 1], jnp.int32)
+    n = 40000
+    keys = jax.vmap(
+        lambda i: jax.random.split(jax.random.PRNGKey(i), kb))(
+        jnp.arange(n))
+    m_all, new_all = jax.vmap(
+        lambda ks: _spec_accept_resample(probs, draft, ks))(keys)
+    first = np.where(np.asarray(m_all) >= 1, int(draft[0]),
+                     np.asarray(new_all))
+    emp = np.bincount(first, minlength=v) / n
+    assert np.abs(emp - np.asarray(probs[0])).max() < 0.015
+
+
+def test_sampled_speculative_deterministic_and_composes(tiny_server):
+    """temperature > 0 speculation: seed-deterministic, varies across
+    seeds, respects top-k masking, streams with fused parity, and the
+    compiled ('spec_s', ...) program is reused across requests."""
+    a = tiny_server.generate_speculative([5, 6, 7], max_new_tokens=10,
+                                         k=4, temperature=1.2, seed=42)
+    b = tiny_server.generate_speculative([5, 6, 7], max_new_tokens=10,
+                                         k=4, temperature=1.2, seed=42)
+    np.testing.assert_array_equal(a, b)
+    draws = [tiny_server.generate_speculative(
+        [5, 6, 7], max_new_tokens=10, k=4, temperature=1.2, seed=s)
+        for s in range(6)]
+    assert any(not np.array_equal(draws[0], d) for d in draws[1:])
+    # top_k=1 collapses sampled speculation to greedy speculation
+    g = tiny_server.generate_speculative([5, 6, 7], max_new_tokens=10,
+                                         k=4)
+    t1 = tiny_server.generate_speculative([5, 6, 7], max_new_tokens=10,
+                                          k=4, temperature=2.0, top_k=1,
+                                          seed=9)
+    np.testing.assert_array_equal(g, t1)
+    # streamed sampled spec == fused sampled spec (same seed)
+    st = np.concatenate(list(tiny_server.generate_speculative_stream(
+        [5, 6, 7], max_new_tokens=10, k=4, temperature=1.2, seed=42)),
+        axis=1)
+    np.testing.assert_array_equal(st, a[:, : st.shape[1]])
+    # compile-once: a second sampled request adds no program
+    count = tiny_server.compile_count
+    tiny_server.generate_speculative([9, 8], max_new_tokens=6, k=4,
+                                     temperature=0.7, top_p=0.9, seed=3)
+    assert tiny_server.compile_count == count
