@@ -90,6 +90,8 @@ class ContinuousBatcher:
         self.segments_run = 0
         self.rows_in_segments = 0
         self.requests_served = 0
+        self.prefill_groups = 0      # engine-side grouped prefill calls
+        self.rows_group_prefilled = 0
 
     # -- device helpers ------------------------------------------------------
 
@@ -281,6 +283,9 @@ class ContinuousBatcher:
             if raw:
                 try:
                     group_carry = self._prefill_group(raw)
+                    with self._lock:
+                        self.prefill_groups += 1
+                        self.rows_group_prefilled += len(raw)
                 except Exception as e:  # noqa: BLE001
                     # a group-prefill failure (fresh-bucket compile
                     # OOM, transient device error) errors ONLY the raw
@@ -540,5 +545,7 @@ class ContinuousBatcher:
                     "segments_run": self.segments_run,
                     "rows_in_segments": self.rows_in_segments,
                     "requests_served": self.requests_served,
+                    "prefill_groups": self.prefill_groups,
+                    "rows_group_prefilled": self.rows_group_prefilled,
                     "active_rows": active,
                     "waiting_joiners": len(self._joiners)}
