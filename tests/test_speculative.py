@@ -332,3 +332,29 @@ def test_sampled_speculative_deterministic_and_composes(tiny_server):
     tiny_server.generate_speculative([9, 8], max_new_tokens=6, k=4,
                                      temperature=0.7, top_p=0.9, seed=3)
     assert tiny_server.compile_count == count
+
+
+@pytest.mark.slow  # fresh model + three compiles on the 1-core box
+def test_speculative_under_int8_kv_cache():
+    """Speculation composes with kv_quant='int8': the verify chunk
+    attends the quantized cache through the same scalar-index branch,
+    and greedy parity with the plain int8-KV decode holds (both paths
+    read identically quantized K/V)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.models.llama import (LLAMA_TINY, LlamaModel,
+                                           LlamaServer)
+
+    cfg = dataclasses.replace(LLAMA_TINY, kv_quant="int8")
+    module = LlamaModel(cfg)
+    tokens = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tokens)
+    server = LlamaServer(module, params)
+    ref = server.generate([5, 6, 7, 8], max_new_tokens=16)
+    for k in (2, 4):
+        out = server.generate_speculative([5, 6, 7, 8],
+                                          max_new_tokens=16, k=k)
+        np.testing.assert_array_equal(out, ref, err_msg=f"k={k}")
