@@ -330,6 +330,8 @@ def test_chunked_joiner_prefill_matches_solo():
             fb.result(), server.generate([5, 6, 7], max_new_tokens=8))
 
 
+@pytest.mark.slow  # deliberate per-chunk sleeps (~17 s); chunked-joiner
+# parity coverage stays fast via test_chunked_joiner_prefill_matches_solo
 def test_decode_segments_proceed_while_joiner_prefills():
     """The interleave claim (VERDICT r5 #4): while a long joiner walks
     its prefill CHUNKS, the engine keeps running decode segments for

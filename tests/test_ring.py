@@ -166,6 +166,8 @@ def test_sp_decode_step_matches_dense_reference(cpu_devices):
     np.testing.assert_array_equal(np.asarray(ncache["v"]), np.asarray(rv))
 
 
+@pytest.mark.slow  # three meshed serves (~31 s); the sp_decode_step unit
+# parity and the engine-over-sp test keep fast coverage
 def test_sp_serve_decode_matches_unsharded(cpu_devices, count_sp_decode):
     """The full serving path with attn_backend='ring' over an sp mesh —
     ring prefill + sequence-sharded flash-decoding steps — produces the
