@@ -199,6 +199,15 @@ def _kv_dequantize(q_i8, scale, dtype):
     return q_i8.astype(dtype) * scale.astype(dtype)
 
 
+def cache_width(cache) -> int:
+    """Sequence capacity of a decode/prefix cache (float or int8
+    layout) — the ONE layout probe shared by the server's bucket math
+    and the continuous engine's pack gate."""
+    entry = cache[0]
+    leaf = entry.get("k", entry.get("k_int8"))
+    return leaf.shape[1]
+
+
 def _kv_store(cfg, k, v) -> dict:
     """This step's (or chunk's) K/V in the cache's storage layout: the
     float leaves, or int8 values + scales under ``cfg.kv_quant``. The
@@ -1528,7 +1537,7 @@ class LlamaServer:
                     self.decode_cap, cfg.max_len - plen - s)
         sbs = min(_next_bucket(s, self.min_bucket),
                   cfg.max_len - plen - steps)
-        cache_len = cache[0].get("k", cache[0].get("k_int8")).shape[1]
+        cache_len = cache_width(cache)
 
         def build():
             def fn(params, cache, suffix, suffix_len, temperature, top_k,
@@ -1622,7 +1631,7 @@ class LlamaServer:
         s = lengths[0]
         self._validate(plen + s, max_new_tokens)
         sbs = min(_next_bucket(s, self.min_bucket), cfg.max_len - plen)
-        cache_len = cache[0].get("k", cache[0].get("k_int8")).shape[1]
+        cache_len = cache_width(cache)
         cont = self._stream_prefix_fn(sbs)
         _, seg = self._stream_fns(1, sbs, cache_len, segment)
         suffix_op, _ = self._pad_rows(rows, lengths, 1, sbs)

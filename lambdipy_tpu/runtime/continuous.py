@@ -353,7 +353,8 @@ class ContinuousBatcher:
                         self.requests_served += 1
                 self._lock.notify_all()
 
-    def _prefill_prefix_row(self, prefix_tokens, row, s: int, entry: dict):
+    def _prefill_prefix_row(self, prefix_tokens, row, s: int, entry: dict,
+                            pentry=None):
         """Continue-prefill from a cached prefix KV -> 1-row carry over
         the FULL context window (the prefix cache's size). The same
         continuation program streaming-with-prefix uses, so packing a
@@ -364,7 +365,8 @@ class ContinuousBatcher:
 
         server = self.server
         cfg = server.model.cfg
-        cache, plen = server._prefix_entry(prefix_tokens)
+        cache, plen = (pentry if pentry is not None
+                       else server._prefix_entry(prefix_tokens))
         server._validate(plen + s, entry["n"])
         sbs = min(_next_bucket(s, server.min_bucket), cfg.max_len - plen)
         cont = server._stream_prefix_fn(sbs)
@@ -397,12 +399,20 @@ class ContinuousBatcher:
                  "want_lp": return_logprobs,
                  "done": False, "error": None, "slot": None, "packed": False}
         if prefix is not None:
-            # a prefix carry's cache is sized to the full context window
-            # (LlamaServer.cache_prefix); it can only pack into an
-            # engine whose slots are that size
-            if self.cache_len != self.server.model.cfg.max_len:
+            # a prefix carry can only pack into an engine whose slots
+            # match its cache width — gate on the ENTRY's actual shape
+            # (today always the full context window, but the stored
+            # cache is the source of truth, not the config constant).
+            # The fetched entry rides into the prefill so the gate and
+            # the continuation use the SAME cache (no second lookup,
+            # no eviction window between them).
+            from lambdipy_tpu.models.llama import cache_width
+
+            pentry = self.server._prefix_entry(prefix)
+            if self.cache_len != cache_width(pentry[0]):
                 return None
-            entry["carry"] = self._prefill_prefix_row(prefix, row, s, entry)
+            entry["carry"] = self._prefill_prefix_row(prefix, row, s,
+                                                      entry, pentry)
         else:
             if s + max_new_tokens > self.cache_len:
                 # a request over the engine's (operator-capped)
