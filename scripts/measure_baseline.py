@@ -241,10 +241,12 @@ def _attach_roofline(record: dict, cfg: dict, n_new: int | None) -> None:
 
 
 def publish(records: dict) -> None:
-    path = REPO / "BASELINE.json"
-    doc = json.loads(path.read_text())
-    doc.setdefault("published", {}).update(records)
-    path.write_text(json.dumps(doc, indent=2))
+    # shared merge+atomic writer: preserves config5's dict-valued
+    # sub-records (published by measure_8b modes) and never leaves a
+    # truncated BASELINE.json when a timeout kills the process mid-write
+    from publish_util import merge_publish
+
+    merge_publish(records)
 
 
 def main() -> int:

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-5 on-chip measurement suite (VERDICT r5 #1, #4-#9): runs every
+# measurement mode sequentially, each under its own timeout so a tunnel
+# wedge skips one mode instead of hanging the suite. Raw stdout/stderr
+# per mode land in $OUT; published records go to BASELINE.json via the
+# modes' own --publish.
+set -u
+cd /root/repo
+OUT=${OUT:-/tmp/r5m}
+mkdir -p "$OUT"
+
+run() {
+  local name=$1 to=$2
+  shift 2
+  echo "=== $name start $(date -u +%FT%TZ)" | tee -a "$OUT/driver.log"
+  timeout "$to" "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+  local rc=$?
+  echo "=== $name rc=$rc end $(date -u +%FT%TZ)" | tee -a "$OUT/driver.log"
+}
+
+# VERDICT r5 #1 first: the speculative number is the round's top ask.
+run spec_k8 2400 python scripts/measure_8b.py --speculative --publish
+run spec_k4 1200 python scripts/measure_8b.py --speculative --k 4
+run spec_k16 1200 python scripts/measure_8b.py --speculative --k 16
+# Driver-shaped artifact with the decode8b stage on-chip (weak #1).
+run bench 2400 python bench.py
+# Refresh the headline b1/b8 + prefill-512 record.
+run decode 2400 python scripts/measure_8b.py --publish
+# VERDICT r5 #6: engine concurrent throughput.
+run concurrent 2400 python scripts/measure_8b.py --concurrent --publish
+# VERDICT r5 #7: int8-KV at 8B dims, 1k context.
+run kvquant 3000 python scripts/measure_8b.py --kv-quant --publish
+# VERDICT r5 #4 + #9: prefill table incl. flash + chunked at 8k.
+run prefill 3600 python scripts/measure_8b.py --prefill-table --publish
+# VERDICT r5 #5: overlapped cold start, measured end-to-end at 8B.
+run coldstart 3600 python scripts/measure_8b.py --cold-start --publish
+echo "=== suite done $(date -u +%FT%TZ)" | tee -a "$OUT/driver.log"
