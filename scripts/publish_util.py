@@ -63,7 +63,19 @@ def merge_publish(records: dict, path: Path | None = None) -> Path:
             key = "config5_micro"
         cur = pub.get(key)
         if isinstance(cur, dict) and isinstance(rec, dict):
-            cur.update(rec)
+            _deep_update(cur, rec)
         else:
             pub[key] = rec
     return write_doc(doc, path)
+
+
+def _deep_update(cur: dict, rec: dict) -> None:
+    """Recursive merge: updating a config with a partial sub-record
+    (e.g. attaching a methodology_note to ``kv_int8``) must not replace
+    the sub-record wholesale — a one-level update did exactly that and
+    silently dropped a published error-bound."""
+    for k, v in rec.items():
+        if isinstance(cur.get(k), dict) and isinstance(v, dict):
+            _deep_update(cur[k], v)
+        else:
+            cur[k] = v
