@@ -1,0 +1,82 @@
+"""The measurement scripts' shared BASELINE.json writer
+(``scripts/publish_util.py``): merge semantics and atomicity.
+
+Every behavior here was a real round-5 incident first: a config-level
+refresh wiped the speculative sub-record, a one-level merge attaching a
+methodology note replaced the kv_int8 sub-record and dropped its
+published error bound, and a micro-exemplar record arriving over the
+real-8B config mislabeled 8B data.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "scripts"))
+
+import publish_util  # noqa: E402
+
+
+def _write(tmp_path, doc):
+    p = tmp_path / "BASELINE.json"
+    p.write_text(json.dumps(doc))
+    return p
+
+
+def _read(p):
+    return json.loads(p.read_text())
+
+
+def test_merge_preserves_sibling_sub_records(tmp_path):
+    p = _write(tmp_path, {"published": {"config5": {
+        "recipe": publish_util.RECIPE_8B,
+        "b1_decode_tok_s": 86.7,
+        "speculative": {"spec_tok_s": 204.0}}}})
+    publish_util.merge_publish({"config5": {"b1_decode_tok_s": 90.0}}, p)
+    c5 = _read(p)["published"]["config5"]
+    assert c5["b1_decode_tok_s"] == 90.0
+    assert c5["speculative"]["spec_tok_s"] == 204.0
+
+
+def test_merge_is_deep_for_nested_sub_records(tmp_path):
+    # attaching a note must not replace the sub-record wholesale
+    p = _write(tmp_path, {"published": {"config5": {"kv_int8": {
+        "greedy_agreement": "64/64", "max_logprob_delta": 0.0283}}}})
+    publish_util.merge_publish(
+        {"config5": {"kv_int8": {"methodology_note": "flagged"}}}, p)
+    kv = _read(p)["published"]["config5"]["kv_int8"]
+    assert kv["greedy_agreement"] == "64/64"
+    assert kv["max_logprob_delta"] == 0.0283
+    assert kv["methodology_note"] == "flagged"
+
+
+def test_micro_record_routes_to_config5_micro_over_8b(tmp_path):
+    p = _write(tmp_path, {"published": {"config5": {
+        "recipe": publish_util.RECIPE_8B,
+        "speculative": {"spec_tok_s": 204.0}}}})
+    publish_util.merge_publish({"config5": {
+        "recipe": publish_util.MICRO_RECIPE, "p50_ms": 3.2}}, p)
+    pub = _read(p)["published"]
+    assert pub["config5_micro"]["p50_ms"] == 3.2
+    assert pub["config5"]["speculative"]["spec_tok_s"] == 204.0
+
+
+def test_micro_record_lands_in_config5_when_no_8b_record(tmp_path):
+    p = _write(tmp_path, {"published": {}})
+    publish_util.merge_publish({"config5": {
+        "recipe": publish_util.MICRO_RECIPE, "p50_ms": 3.2}}, p)
+    assert _read(p)["published"]["config5"]["p50_ms"] == 3.2
+
+
+def test_write_doc_leaves_no_tmp_file(tmp_path):
+    p = _write(tmp_path, {"published": {}})
+    publish_util.merge_publish({"config1": {"ok": 1}}, p)
+    assert _read(p)["published"]["config1"] == {"ok": 1}
+    assert list(tmp_path.glob("*.tmp")) == []
+
+
+def test_non_dict_existing_value_is_replaced(tmp_path):
+    p = _write(tmp_path, {"published": {"config2": "legacy-string"}})
+    publish_util.merge_publish({"config2": {"p50_ms": 1.0}}, p)
+    assert _read(p)["published"]["config2"] == {"p50_ms": 1.0}
