@@ -293,6 +293,18 @@ def _attach_last_device_record(result: dict) -> None:
             note["llama8b_b8_tok_s"] = c5.get("b8_decode_tok_s")
             note["llama8b_hbm_util"] = c5.get("b1_decode_hbm_util")
             note["llama8b_measured_at"] = c5.get("measured_at")
+        spec = c5.get("speculative", {})
+        # same device-only gate as the sibling blocks: the mode runs
+        # anywhere, so an off-chip publish must not read as a device
+        # number (older records lack their own platform field — fall
+        # back to the enclosing config5's)
+        spec_platform = spec.get("platform", c5.get("platform"))
+        if spec.get("spec_tok_s") is not None and \
+                spec_platform not in ("cpu", None):
+            note["llama8b_spec_tok_s"] = spec["spec_tok_s"]
+            note["llama8b_spec_tokens_per_step"] = (
+                spec.get("spec_stats", {}).get("tokens_per_step"))
+            note["llama8b_spec_measured_at"] = spec.get("measured_at")
         if note:
             result["last_published_device"] = note
     except Exception:  # informational only — never break the bench line

@@ -322,8 +322,11 @@ def measure_speculative(n_new: int = 64, k: int = 8) -> dict:
     adapter = registry.get("llama3-8b").build(
         dtype="bfloat16", quant="int8", extra=dict(DIMS))
     server = adapter.make_server(params)
+    import jax
+
     rec = {"dims": f"{DIMS['hidden']}x{DIMS['layers']}x{DIMS['vocab_size']}",
            "rtt_ms": round(rtt, 1), "k": k, "n_new": n_new,
+           "platform": jax.devices()[0].platform,
            "measured_at": time.strftime("%Y-%m-%d")}
     prompt = [17, 23, 5, 99, 41, 7, 123, 64] * 4
 
