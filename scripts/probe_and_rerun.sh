@@ -1,0 +1,51 @@
+#!/bin/bash
+# Probe-gated rerun of the remaining round-5 measurement modes: the
+# tunnel wedged mid-suite (PROBE_LOG.jsonl 2026-07-31T06:13), so poll
+# device health every 10 min and launch the remaining modes only when a
+# full probe (enumerate + matmul + device_get) succeeds. Gives up when
+# the deadline passes. The probe intentionally runs the WHOLE device
+# path in a killable child: in the wedged state even backend init hangs
+# indefinitely, and a probe that merely imports jax would hang the loop.
+set -u
+cd /root/repo
+OUT=/tmp/r5m3
+mkdir -p "$OUT"
+DEADLINE=$(( $(date +%s) + 7*3600 ))
+
+probe() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d[0].platform == 'tpu', d
+x = jnp.ones((256, 256), jnp.bfloat16)
+v = float((x @ x).sum())
+print('probe ok', v)
+" >>"$OUT/probe.log" 2>&1
+}
+
+run() {
+  local name=$1 to=$2
+  shift 2
+  echo "=== $name start $(date -u +%FT%TZ)" | tee -a "$OUT/driver.log"
+  timeout "$to" "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+  echo "=== $name rc=$? end $(date -u +%FT%TZ)" | tee -a "$OUT/driver.log"
+}
+
+n=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  n=$((n + 1))
+  if probe; then
+    echo "=== probe $n ok $(date -u +%FT%TZ) — launching modes" \
+      | tee -a "$OUT/driver.log"
+    run kvquant 3000 python scripts/measure_8b.py --kv-quant --publish
+    run prefill 3600 python scripts/measure_8b.py --prefill-table --publish
+    run decode 2400 python scripts/measure_8b.py --publish
+    run concurrent 2400 python scripts/measure_8b.py --concurrent --publish
+    run coldstart 3600 python scripts/measure_8b.py --cold-start --publish
+    echo "=== rerun suite done $(date -u +%FT%TZ)" | tee -a "$OUT/driver.log"
+    exit 0
+  fi
+  echo "=== probe $n failed $(date -u +%FT%TZ)" | tee -a "$OUT/driver.log"
+  sleep 600
+done
+echo "=== deadline passed, giving up $(date -u +%FT%TZ)" | tee -a "$OUT/driver.log"
