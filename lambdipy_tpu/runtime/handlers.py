@@ -396,9 +396,15 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     _warm_lock = threading.Lock()
     _warm_started = False
 
+    # the continuous engine's ragged group-prefill programs are another
+    # first-burst compile cliff (measured: ~30 s of remote compiles when
+    # 8 joiners arrive at once) — warm them on the same daemon
+    warm_group = (continuous is not None
+                  and str(extra.get("warm_group_prefill", "1")) != "0")
+
     def _maybe_start_bucket_warm():
         nonlocal _warm_started
-        if not warm_state["requested"]:
+        if not warm_state["requested"] and not warm_group:
             return
         with _warm_lock:  # atomic test-and-set: exactly one warm thread
             if _warm_started:
@@ -420,7 +426,15 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                     # and one bad bucket must not abandon the rest
                     with _warm_lock:
                         warm_state["errors"].append(f"bucket {size}: {e}")
-            # the big buckets this thread just compiled should boot from
+            if warm_group:
+                try:
+                    n = continuous.warm_group_prefill()
+                    with _warm_lock:
+                        warm_state["done"].append(f"group_prefill:{n}")
+                except Exception as e:
+                    with _warm_lock:
+                        warm_state["errors"].append(f"group_prefill: {e}")
+            # the programs this thread just compiled should boot from
             # the AOT tier next time too
             try:
                 server.aot_save_all()
@@ -788,9 +802,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 "seconds": preload_state.get("seconds")}
         if batcher is not None:
             out["batching"] = batcher.stats()
-        if warm_state["requested"]:
-            # snapshot under the lock: the warm daemon appends to these
-            # lists while we serialize them
+        if any(warm_state.values()):  # listed buckets OR the engine's
+            # group-prefill warm — snapshot under the lock: the warm
+            # daemon appends to these lists while we serialize them
             with _warm_lock:
                 out["warm_buckets"] = {
                     k: list(v) if isinstance(v, list) else v
