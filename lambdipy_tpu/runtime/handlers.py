@@ -340,6 +340,13 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # long prefixes prefill in fixed-width chunks: dense-attention
             # memory O(chunk x s) instead of O(s^2), O(1) programs
             server_caps["prefill_chunk"] = int(extra["prefill_chunk"])
+        if extra.get("min_bucket") is not None:
+            # smallest prompt/decode bucket. The default 16 makes a
+            # max_new_tokens=1 request run a 16-step scan — ~16 wasted
+            # weight reads (~165 ms at 8B): scoring/logprob workloads
+            # dominated by tiny decodes should set 1, trading a few
+            # more compiled program variants per distinct length
+            server_caps["min_bucket"] = int(extra["min_bucket"])
         if mesh is None and getattr(ctx, "bundle_dir", None) is not None \
                 and str(extra.get("serve_aot", "1")) != "0":
             # serving programs ride the bundle's AOT exec tier: at real

@@ -571,3 +571,22 @@ def test_bundle_params_from_checkpoint_path(tmp_path):
                               run_smoke=False)
         assemble_bundle(result, tmp_path / "w-bad" / "bundle",
                         with_payload=True)
+
+
+def test_min_bucket_recipe_knob_reaches_server(tmp_path):
+    """[payload.extra] min_bucket = 1 must reach LlamaServer: a
+    max_new_tokens=1 invoke then runs a ONE-step decode scan instead of
+    the default 16-step bucket (~16 wasted weight reads at 8B for
+    scoring workloads)."""
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "4", "min_bucket": "1"})
+    r = load_bundle(bundle, warmup=True)
+    out = r.handler.invoke(r.state, {"tokens": [1, 2, 3],
+                                     "max_new_tokens": 1})
+    assert out["ok"] and len(out["tokens"][0]) == 1
+    buckets = r.state.stats()["decode_buckets"]
+    assert any(b[-1] == 1 for b in buckets), buckets
