@@ -8,9 +8,9 @@
 # indefinitely, and a probe that merely imports jax would hang the loop.
 set -u
 cd /root/repo
-OUT=/tmp/r5m3
+OUT=${OUT:-/tmp/r5m3}
 mkdir -p "$OUT"
-DEADLINE=$(( $(date +%s) + 7*3600 ))
+DEADLINE=$(( $(date +%s) + ${DEADLINE_HOURS:-7}*3600 ))
 
 probe() {
   timeout 120 python -c "
