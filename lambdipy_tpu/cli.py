@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 import tempfile
 from pathlib import Path
@@ -435,12 +436,36 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
 @click.argument("bundle")
 @click.option("--port", type=int, default=8080)
 @click.option("--registry", "registry_dir", type=click.Path(), default=None)
-def serve_cmd(bundle, port, registry_dir):
+@click.option("--sched-policy", default=None,
+              type=click.Choice(["fifo", "priority", "fair"]),
+              help="dequeue policy between request classes "
+                   "(default: bundle sched_policy, else fair)")
+@click.option("--sched-concurrency", type=int, default=None,
+              help="invokes running at once (default 8)")
+@click.option("--sched-queue-cap", type=int, default=None,
+              help="bounded queue depth; beyond it requests shed 503 "
+                   "(default 64)")
+@click.option("--sched-rate", type=float, default=None,
+              help="per-tenant admission rate, requests/s (keyed by "
+                   "x-api-key/x-tenant; 0 = unlimited)")
+@click.option("--sched-burst", type=float, default=None,
+              help="per-tenant token-bucket burst (default 2x rate)")
+def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
+              sched_queue_cap, sched_rate, sched_burst):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
-    server = BundleServer(_resolve_bundle(bundle, registry_dir), port=port)
+    # BundleServer resolves the effective policy (bundle extra <
+    # LAMBDIPY_SCHED_POLICY env < these flags) and bridges it to the
+    # handler's batch formation itself — no env plumbing needed here
+    server = BundleServer(
+        _resolve_bundle(bundle, registry_dir), port=port,
+        sched={"policy": sched_policy,
+               "max_concurrency": sched_concurrency,
+               "queue_cap": sched_queue_cap,
+               "rate": sched_rate, "burst": sched_burst})
     click.echo(json.dumps({"ready": True, "port": server.port,
+                           "sched_policy": server.sched.policy.name,
                            "cold_start": server.boot.stages}))
     server.serve_forever()
 

@@ -30,6 +30,7 @@ Opt-in per bundle: ``[payload.extra] batch_window_ms = 2`` (0 = off).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any
@@ -38,21 +39,46 @@ from lambdipy_tpu.utils.logs import get_logger
 
 log = get_logger("lambdipy.batching")
 
+_seq = itertools.count()
+
 
 class MicroBatcher:
-    """Group concurrent single-row generate calls into ragged batches."""
+    """Group concurrent single-row generate calls into ragged batches.
+
+    ``policy`` (a :mod:`lambdipy_tpu.sched.policy` object) orders batch
+    formation: pending entries are drained in policy order — priority /
+    fair-share classes (tagged from the scheduler's request context) go
+    first — instead of raw arrival order. None keeps arrival order."""
 
     def __init__(self, server: Any, *, window_ms: float = 2.0,
-                 max_batch: int = 8):
+                 max_batch: int = 8, policy: Any = None):
         self.server = server
         self.window_s = max(0.0, window_ms) / 1e3
         self.max_batch = max(1, max_batch)
+        self.policy = policy
         self._cond = threading.Condition()
         self._pending: list[dict] = []
+        self._collecting = False   # a leader is inside its window
         self.batches_run = 0
         self.rows_served = 0
 
     # -- internals ----------------------------------------------------------
+
+    def _ordered_locked(self) -> list[dict]:
+        """Pending entries in handoff order (policy order, else arrival)."""
+        if self.policy is None:
+            return list(self._pending)
+        return self.policy.order(list(self._pending))
+
+    def _head_locked(self) -> dict | None:
+        """The entry whose thread should serve the next group — the
+        policy's state-free head pick (wait loops poll this; a mutating
+        pick could livelock two out-of-phase waiters)."""
+        if not self._pending:
+            return None
+        if self.policy is None:
+            return self._pending[0]
+        return self.policy.head(self._pending)
 
     def _drain_locked(self) -> list[dict]:
         """Take pending entries that can legally FUSE: the fused call
@@ -60,12 +86,23 @@ class MicroBatcher:
         so an entry valid solo may be incompatible with the forming
         batch — it stays queued for a later batch rather than poisoning
         this one. The head entry is always taken, alone if need be, so
-        its own (possibly invalid) request errors only to its caller."""
+        its own (possibly invalid) request errors only to its caller.
+        Candidate order is the POLICY's, not arrival's, so scheduling
+        class decides who rides a contended batch."""
         max_len = self.server.model.cfg.max_len
         cap = self.server.decode_cap
+        ordered = self._ordered_locked()
+        head = self._head_locked()
+        if head is not None and ordered and ordered[0] is not head:
+            # the unconditionally-taken first slot must be the policy
+            # HEAD (the entry whose thread serves this group): that is
+            # the progress invariant — a never-fusing head would
+            # otherwise re-serve groups forever without retiring
+            ordered.remove(head)
+            ordered.insert(0, head)
         batch: list[dict] = []
         s_max = n_max = 0
-        for e in list(self._pending):
+        for e in ordered:
             if len(batch) >= self.max_batch:
                 break
             s = max(s_max, len(e["row"]))
@@ -124,14 +161,19 @@ class MicroBatcher:
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 seed=seed, eos_id=eos_id, return_logprobs=return_logprobs)
 
+        from lambdipy_tpu.sched import current_request_class
+
         entry = {"row": prompt_row, "n": max_new_tokens,
                  "temperature": temperature, "top_k": top_k, "top_p": top_p,
                  "seed": seed, "eos_id": eos_id,
                  "want_lp": return_logprobs, "lps": None,
-                 "done": False, "result": None, "error": None}
+                 "done": False, "result": None, "error": None,
+                 "cls": current_request_class(), "seq": next(_seq)}
         with self._cond:
             self._pending.append(entry)
             leader = len(self._pending) == 1
+            if leader:
+                self._collecting = True
             self._cond.notify_all()  # a collecting leader may now be full
         if leader:
             # collect for one window, waking early once full anyway
@@ -141,19 +183,24 @@ class MicroBatcher:
                     if len(self._pending) >= self.max_batch:
                         break
                     self._cond.wait(timeout=remaining)
+                self._collecting = False
             self._serve_group()
         while True:
             with self._cond:
                 if entry["done"]:
                     break
-                if not (self._pending and self._pending[0] is entry):
-                    # another thread's batch is in flight (or its leader is
-                    # still collecting); the post-batch notify wakes us
+                if self._collecting or self._head_locked() is not entry:
+                    # a leader is still collecting its window (a policy-
+                    # head arrival must not truncate it — that collapses
+                    # batch sizes under mixed-class traffic), or another
+                    # thread's batch is in flight; the post-batch /
+                    # post-window notify wakes us
                     self._cond.wait(timeout=1.0)
                     continue
-            # we are the queue head: serve our own group now instead of
-            # waiting out a timeout (covers leader-overflow leftovers and
-            # entries the previous batch couldn't legally fuse)
+            # we are the POLICY's queue head: serve our own group now
+            # instead of waiting out a timeout (covers leader-overflow
+            # leftovers and entries the previous batch couldn't legally
+            # fuse)
             self._serve_group()
         if entry["error"] is not None:
             raise entry["error"]

@@ -49,6 +49,7 @@ Opt-in per bundle: ``[payload.extra] batch_mode = "continuous"``
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Any
 
@@ -56,19 +57,25 @@ from lambdipy_tpu.utils.logs import get_logger
 
 log = get_logger("lambdipy.continuous")
 
+_entry_seq = itertools.count()
+
 
 class ContinuousBatcher:
     """Segment-boundary continuous batching over a LlamaServer."""
 
     def __init__(self, server: Any, *, slots: int = 8, segment: int = 16,
                  cache_len: int | None = None,
-                 group_prefill_max: int = 256):
+                 group_prefill_max: int = 256, policy: Any = None):
         import jax
 
         self.server = server
         cfg = server.model.cfg
         self.slots = max(1, slots)
         self.segment = max(1, segment)
+        # sched policy: when slots are scarce, waiting joiners are packed
+        # in POLICY order (priority / fair-share by request class from
+        # the scheduler's context) instead of arrival order; None = FIFO
+        self.policy = policy
         self.cache_len = min(cache_len or cfg.max_len, cfg.max_len)
         # prompts up to this length enqueue RAW and the engine prefills
         # them together in one ragged b-row call (prefill MFU at short
@@ -300,10 +307,19 @@ class ContinuousBatcher:
         while True:
             with self._lock:
                 free = [i for i, a in enumerate(self._active) if a is None]
-                while self._joiners and free:
-                    joiner = self._joiners.pop(0)
-                    joiner["slot"] = free.pop(0)
-                    self._active[joiner["slot"]] = joiner
+                if self._joiners and free:
+                    # slot handoff dequeues by policy: under slot
+                    # contention the scheduling class (not arrival
+                    # order) decides who joins the in-flight batch next
+                    ordered = (self.policy.order(list(self._joiners))
+                               if self.policy is not None
+                               else list(self._joiners))
+                    for joiner in ordered:
+                        if not free:
+                            break
+                        self._joiners.remove(joiner)
+                        joiner["slot"] = free.pop(0)
+                        self._active[joiner["slot"]] = joiner
                 packing = [a for a in self._active
                            if a is not None and not a.get("packed")]
                 if not any(self._active):
@@ -425,6 +441,8 @@ class ContinuousBatcher:
         smaller than the prefix cache's full window)."""
         import numpy as np
 
+        from lambdipy_tpu.sched import current_request_class
+
         if max_new_tokens <= 0:
             return None
         row = np.asarray(prompt_row, np.int32).reshape(-1).tolist()
@@ -433,7 +451,8 @@ class ContinuousBatcher:
                  "temperature": temperature, "top_k": top_k, "top_p": top_p,
                  "seed": seed, "toks": [], "lps": [],
                  "want_lp": return_logprobs,
-                 "done": False, "error": None, "slot": None, "packed": False}
+                 "done": False, "error": None, "slot": None, "packed": False,
+                 "cls": current_request_class(), "seq": next(_entry_seq)}
         if prefix is not None:
             # a prefix carry can only pack into an engine whose slots
             # match its cache width — gate on the ENTRY's actual shape

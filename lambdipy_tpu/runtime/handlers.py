@@ -365,6 +365,24 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         server = adapter.make_server(params, mesh=mesh, **server_caps)
         window_ms = float(extra.get("batch_window_ms", 0) or 0)
         batch_mode = str(extra.get("batch_mode", "") or "").lower()
+        # batch formation dequeues by the bundle's scheduling policy
+        # (the same [payload.extra] sched_policy the HTTP scheduler
+        # uses), so request class survives INTO the batchers.
+        # LAMBDIPY_SCHED_POLICY is the serve-process override (set by
+        # `lambdipy serve --sched-policy`): the handler is built inside
+        # load_bundle, before the server's scheduler exists, so the CLI
+        # choice reaches batch formation through the environment.
+        import os as _os
+
+        # default matches the HTTP scheduler's default ("fair"), so batch
+        # formation honors class fairness even when nothing is configured
+        # — /metrics reporting policy "fair" while batches board FIFO
+        # would be a lie
+        pol_name = (_os.environ.get("LAMBDIPY_SCHED_POLICY")
+                    or extra.get("sched_policy") or "fair")
+        from lambdipy_tpu.sched.policy import make_policy
+
+        sched_policy = make_policy(str(pol_name))
         if batch_mode == "continuous":
             from lambdipy_tpu.runtime.continuous import ContinuousBatcher
 
@@ -376,13 +394,15 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             batcher = continuous = ContinuousBatcher(
                 server, slots=int(extra.get("batch_max", 8)),
                 segment=int(extra.get("batch_segment", 16)),
-                cache_len=int(bcl) if bcl else None)
+                cache_len=int(bcl) if bcl else None,
+                policy=sched_policy)
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 
             # concurrent same-knob requests share one ragged device call
             batcher = MicroBatcher(server, window_ms=window_ms,
-                                   max_batch=int(extra.get("batch_max", 8)))
+                                   max_batch=int(extra.get("batch_max", 8)),
+                                   policy=sched_policy)
 
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a

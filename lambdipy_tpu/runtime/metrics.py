@@ -23,29 +23,43 @@ class LatencyStats:
 
     def record(self, ms: float) -> None:
         with self._lock:
-            self.count += 1
+            # ring position is the PRE-increment count: sample N lands at
+            # index N % capacity, so the first wraparound overwrite hits
+            # slot 0 (incrementing first skewed the ring by one and made
+            # slot 0 immortal)
             if len(self.samples) >= self.capacity:
                 self.samples[self.count % self.capacity] = ms
             else:
                 self.samples.append(ms)
+            self.count += 1
 
     def record_error(self) -> None:
         with self._lock:
             self.errors += 1
 
+    @staticmethod
+    def _percentile(samples: list[float], q: float) -> float | None:
+        if not samples:
+            return None
+        s = sorted(samples)
+        idx = min(len(s) - 1, max(0, round(q / 100.0 * (len(s) - 1))))
+        return s[idx]
+
     def percentile(self, q: float) -> float | None:
         with self._lock:
-            if not self.samples:
-                return None
-            s = sorted(self.samples)
-            idx = min(len(s) - 1, max(0, round(q / 100.0 * (len(s) - 1))))
-            return s[idx]
+            samples = list(self.samples)
+        return self._percentile(samples, q)
 
     def report(self) -> dict:
+        # one consistent snapshot: count/errors/samples move together, so
+        # read them all under the lock and compute percentiles outside it
+        with self._lock:
+            count, errors = self.count, self.errors
+            samples = list(self.samples)
         return {
-            "count": self.count,
-            "errors": self.errors,
-            "p50_ms": self.percentile(50),
-            "p90_ms": self.percentile(90),
-            "p99_ms": self.percentile(99),
+            "count": count,
+            "errors": errors,
+            "p50_ms": self._percentile(samples, 50),
+            "p90_ms": self._percentile(samples, 90),
+            "p99_ms": self._percentile(samples, 99),
         }

@@ -8,6 +8,14 @@ Endpoints:
 - ``GET  /metrics``  latency percentiles + error counts (JSON)
 - ``POST /invoke``   JSON request -> handler -> JSON response
 
+Every invoke passes the SLO scheduler (lambdipy_tpu/sched): admission
+control (per-tenant token buckets, a bounded queue, deadline-based
+shedding on ``x-deadline-ms``) then a policy-ordered wait for one of
+``max_concurrency`` run slots. Overload turns into explicit 429/503
+responses carrying ``Retry-After`` instead of unbounded latency; request
+class rides the ``x-priority`` header (interactive | batch | background),
+tenant identity the ``x-api-key`` / ``x-tenant`` header.
+
 Failure behavior (SURVEY.md §6 failure-detection row): handler exceptions
 return 500 with the error type and are counted; the process stays up.
 ``POST /shutdown`` drains and stops (used by the deploy controller).
@@ -16,6 +24,7 @@ return 500 with the error type and are counted; the process stays up.
 from __future__ import annotations
 
 import json
+import math
 import os
 import signal
 import threading
@@ -25,9 +34,42 @@ from pathlib import Path
 
 from lambdipy_tpu.runtime.loader import BootReport, load_bundle
 from lambdipy_tpu.runtime.metrics import LatencyStats
+from lambdipy_tpu.sched import (
+    SchedConfig,
+    Scheduler,
+    Shed,
+    clear_request_context,
+    set_request_context,
+)
 from lambdipy_tpu.utils.logs import get_logger, log_event
 
 log = get_logger("lambdipy.server")
+
+
+def _request_token_counts(request: dict | None) -> tuple[int, int]:
+    """Best-effort (prefill, decode) token counts for the cost estimator:
+    wrong-shaped fields count as zero — sizing is advisory, validation
+    belongs to the handler."""
+    if not isinstance(request, dict):
+        return 0, 0
+    prefill = 0
+    toks = request.get("tokens")
+    if isinstance(toks, (list, tuple)):
+        if toks and isinstance(toks[0], (list, tuple)):
+            prefill = sum(len(r) for r in toks
+                          if isinstance(r, (list, tuple)))
+        else:
+            prefill = len(toks)
+    prefix = request.get("prefix")
+    if isinstance(prefix, (list, tuple)):
+        prefill += len(prefix)
+    decode = 0
+    for key in ("max_new_tokens", "max_tokens"):
+        raw = request.get(key)
+        if isinstance(raw, (int, float)):
+            decode = max(0, int(raw))
+            break
+    return prefill, decode
 
 
 def _openai_to_internal(req: dict) -> tuple[dict, str | None]:
@@ -107,7 +149,7 @@ def _internal_to_openai(internal: dict, result: dict) -> dict:
 
 class BundleServer:
     def __init__(self, bundle_dir: Path, host: str = "127.0.0.1", port: int = 0,
-                 *, warmup: bool = True):
+                 *, warmup: bool = True, sched: dict | None = None):
         self.bundle_dir = Path(bundle_dir)
         self.stats = LatencyStats()
         self._profile_lock = threading.Lock()
@@ -115,7 +157,46 @@ class BundleServer:
         self._inflight_lock = threading.Lock()
         self.draining = False
         self.started = time.time()
-        self.boot: BootReport = load_bundle(self.bundle_dir, warmup=warmup)
+        # The generate handler builds its batchers INSIDE load_bundle, so
+        # the effective policy must be resolved first and bridged through
+        # the env var the handler reads — otherwise a programmatic
+        # sched={"policy": ...} would report one policy on /metrics while
+        # batch formation ordered by another. (Pre-read the manifest
+        # best-effort; the authoritative extra comes from the boot below.)
+        pre_extra: dict = {}
+        try:
+            pre_extra = (json.loads(
+                (self.bundle_dir / "manifest.json").read_text())
+                .get("payload") or {}).get("extra") or {}
+        except (OSError, ValueError):
+            pass
+        pre_policy = SchedConfig.from_extra(pre_extra, **(sched or {})).policy
+        prev_env = os.environ.get("LAMBDIPY_SCHED_POLICY")
+        os.environ["LAMBDIPY_SCHED_POLICY"] = pre_policy
+        try:
+            self.boot: BootReport = load_bundle(self.bundle_dir,
+                                                warmup=warmup)
+        finally:
+            if prev_env is None:
+                os.environ.pop("LAMBDIPY_SCHED_POLICY", None)
+            else:
+                os.environ["LAMBDIPY_SCHED_POLICY"] = prev_env
+        # SLO scheduler config layers: bundle [payload.extra] sched_* keys,
+        # overridden by explicit ctor/CLI values
+        extra = (self.boot.manifest.get("payload") or {}).get("extra") or {}
+        cfg = SchedConfig.from_extra(extra, **(sched or {}))
+        # a batching bundle sized past the default run-slot count must not
+        # be silently throttled to 8 concurrent invokes: unless the
+        # operator pinned sched_max_concurrency, floor the slots at the
+        # batcher's own width so every batch slot can actually fill
+        explicit = (extra.get("sched_max_concurrency") is not None
+                    or (sched or {}).get("max_concurrency") is not None)
+        batching = (str(extra.get("batch_mode", "")).lower() == "continuous"
+                    or float(extra.get("batch_window_ms", 0) or 0) > 0)
+        if not explicit and batching:
+            cfg.max_concurrency = max(cfg.max_concurrency,
+                                      int(extra.get("batch_max", 8)))
+        self.sched = Scheduler(cfg)
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
@@ -129,11 +210,14 @@ class BundleServer:
             def log_message(self, fmt, *args):  # route through structured logs
                 log.debug(fmt % args)
 
-            def _send(self, code: int, payload: dict):
+            def _send(self, code: int, payload: dict,
+                      headers: dict | None = None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -153,9 +237,15 @@ class BundleServer:
                         "warm": server_self.boot.manifest.get("warm"),
                         # non-empty = numerics sanitizer on (per-call sync)
                         "debug_flags": server_self.boot.debug_flags,
+                        "sched": {"policy": server_self.sched.policy.name,
+                                  "queued": server_self.sched.queue.depth()},
                     })
                 elif self.path == "/metrics":
                     report = server_self.stats.report()
+                    # admission/scheduling surface: queue depths, shed
+                    # counts by reason/class, per-class queue-wait
+                    # percentiles, cost-model state
+                    report["sched"] = server_self.sched.report()
                     handler_stats = getattr(server_self.boot.state, "stats",
                                             lambda: {})()
                     if handler_stats:
@@ -177,18 +267,79 @@ class BundleServer:
                     self._send(400, {"ok": False, "error": f"bad request: {e}"})
                     return None
 
-            def _begin_invoke(self) -> bool:
-                """Draining check + in-flight increment as one atomic
-                step: stop() can then never observe inflight==0 while an
-                accepted invoke is still on its way to dispatch. False =
-                draining (caller sends its 503/error)."""
+            def _send_shed(self, shed: Shed, *, openai: bool = False):
+                """An explicit overload rejection: 429/503 + Retry-After
+                (integer seconds per RFC 9110; the body carries the exact
+                float for clients that want tighter backoff)."""
+                headers = {"Retry-After":
+                           str(max(1, math.ceil(shed.retry_after_s)))}
+                if openai:
+                    payload = {"error": {
+                        "message": f"shed: {shed.reason}",
+                        "type": ("rate_limit_error" if shed.code == 429
+                                 else "overloaded_error"),
+                        "retry_after_s": round(shed.retry_after_s, 3)}}
+                else:
+                    payload = shed.payload()
+                self._send(shed.code, payload, headers)
+
+            def _begin_invoke(self, request: dict | None = None, *,
+                              openai: bool = False):
+                """Admission gate every invoke passes: draining check +
+                in-flight increment as one atomic step (stop() can then
+                never observe inflight==0 while an accepted invoke is
+                still on its way to dispatch), then scheduler admission
+                (rate / queue-depth / deadline shedding) and a
+                policy-ordered wait for a run slot. Returns a live
+                ticket, or None after sending the 429/503 (with
+                Retry-After) itself."""
+                cls = (self.headers.get("x-priority")
+                       or "interactive").strip().lower()
+                tenant = (self.headers.get("x-api-key")
+                          or self.headers.get("x-tenant") or "anon")
+                try:
+                    deadline_ms = float(self.headers["x-deadline-ms"])
+                except (KeyError, TypeError, ValueError):
+                    deadline_ms = None
                 with server_self._inflight_lock:
                     draining = server_self.draining
                     if not draining:
                         server_self._inflight += 1
-                return not draining
+                if draining:
+                    server_self.sched.admission.count_shed("draining", cls)
+                    self._send_shed(Shed(503, "draining", 1.0),
+                                    openai=openai)
+                    return None
+                prefill, decode = _request_token_counts(request)
+                out = server_self.sched.admit(
+                    tenant=tenant, cls=cls, deadline_ms=deadline_ms,
+                    prefill_tokens=prefill, decode_tokens=decode)
+                if isinstance(out, Shed):
+                    with server_self._inflight_lock:
+                        server_self._inflight -= 1
+                    self._send_shed(out, openai=openai)
+                    return None
+                if not server_self.sched.wait_turn(out):
+                    # deadline became unmeetable while queued: shed at
+                    # grant time instead of burning the slot
+                    with server_self._inflight_lock:
+                        server_self._inflight -= 1
+                    self._send_shed(
+                        Shed(503, "deadline",
+                             max(0.05, out.cost_ms / 1e3)), openai=openai)
+                    return None
+                # the batchers read the request's class from this context
+                # when forming batches (policy-ordered handoff)
+                set_request_context(cls=out.cls, tenant=tenant,
+                                    deadline_ms=deadline_ms)
+                return out
 
-            def _end_invoke(self) -> None:
+            def _end_invoke(self, ticket, t0: float) -> None:
+                clear_request_context()
+                # feed the estimator with slot-occupancy time (errors
+                # included — an erroring request still held the slot)
+                server_self.sched.finish(
+                    ticket, service_ms=(time.monotonic() - t0) * 1e3)
                 with server_self._inflight_lock:
                     server_self._inflight -= 1
 
@@ -242,8 +393,8 @@ class BundleServer:
                 if request is None:
                     server_self.stats.record_error()
                     return
-                if not self._begin_invoke():
-                    self._send(503, {"ok": False, "error": "draining"})
+                ticket = self._begin_invoke(request)
+                if ticket is None:
                     return
                 t0 = time.monotonic()
                 # in-flight covers the response write too: drain must not
@@ -270,7 +421,7 @@ class BundleServer:
                     server_self.stats.record((time.monotonic() - t0) * 1e3)
                     self._send(200, result)
                 finally:
-                    self._end_invoke()
+                    self._end_invoke(ticket, t0)
 
             def _openai_completions(self):
                 """OpenAI-compatible shim over the generate handler:
@@ -286,10 +437,14 @@ class BundleServer:
                     self._send(400, {"error": {"message": err,
                                                "type": "invalid_request_error"}})
                     return
-                if not self._begin_invoke():
-                    self._send(503, {"error": {"message": "draining",
-                                               "type": "unavailable"}})
+                # admit on the TRANSLATED request: the internal shape
+                # carries "tokens"/"max_new_tokens", so the estimator
+                # sees real prefill/decode counts (the raw OpenAI body
+                # keys them "prompt"/"max_tokens")
+                ticket = self._begin_invoke(internal, openai=True)
+                if ticket is None:
                     return
+                t_start = time.monotonic()
                 try:
                     if internal.pop("stream", False):
                         state = server_self.boot.state
@@ -318,7 +473,7 @@ class BundleServer:
                     server_self.stats.record((time.monotonic() - t0) * 1e3)
                     self._send(200, _internal_to_openai(internal, result))
                 finally:
-                    self._end_invoke()
+                    self._end_invoke(ticket, t_start)
 
             def _write_frame(self, body: bytes) -> bool:
                 """One chunked-transfer frame; False = client went away
@@ -463,11 +618,14 @@ class BundleServer:
         return self
 
     def stop(self, *, drain_grace: float = 10.0):
-        """Drain then stop: new invokes get 503 while in-flight ones finish
-        (handler threads are daemonic — without this wait a process exit
-        would cut device work mid-dispatch)."""
+        """Drain then stop: admission closes FIRST (new invokes get 503 +
+        Retry-After from both the server gate and the scheduler), then
+        in-flight AND already-queued invokes finish (handler threads are
+        daemonic — without this wait a process exit would cut device work
+        mid-dispatch)."""
         with self._inflight_lock:
             self.draining = True
+        self.sched.drain()
         deadline = time.monotonic() + drain_grace
         while self._inflight > 0 and time.monotonic() < deadline:
             time.sleep(0.02)

@@ -204,9 +204,8 @@ def _attach_roofline(record: dict, cfg: dict, n_new: int | None) -> None:
     utilization for the Llama configs, computed from the recipe's own
     dims (read from its TOML, so the record can never drift from what
     was actually served)."""
-    import tomllib
-
     from lambdipy_tpu.utils import roofline
+    from lambdipy_tpu.utils.toml_compat import tomllib
 
     measured_ms = record.get("serve_overhead_p50_ms",
                              record.get("invoke_p50_ms", 0))

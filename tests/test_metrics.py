@@ -1,0 +1,85 @@
+"""LatencyStats: percentile edge cases + reservoir wraparound (the seed
+overwrote with the post-increment count, skewing the ring by one and
+making slot 0 immortal)."""
+
+import threading
+
+from lambdipy_tpu.runtime.metrics import LatencyStats
+
+
+def test_empty_reservoir_reports_none():
+    stats = LatencyStats()
+    report = stats.report()
+    assert report["count"] == 0 and report["errors"] == 0
+    assert report["p50_ms"] is None
+    assert report["p90_ms"] is None
+    assert report["p99_ms"] is None
+    assert stats.percentile(50) is None
+
+
+def test_single_sample_every_percentile():
+    stats = LatencyStats()
+    stats.record(42.0)
+    report = stats.report()
+    assert report["count"] == 1
+    assert report["p50_ms"] == report["p90_ms"] == report["p99_ms"] == 42.0
+
+
+def test_wraparound_overwrites_oldest_first():
+    """After capacity, sample N lands at ring slot N % capacity: the
+    FIRST overwrite must hit slot 0 (the oldest sample), not slot 1."""
+    stats = LatencyStats(capacity=4)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        stats.record(v)
+    assert stats.samples == [1.0, 2.0, 3.0, 4.0]
+    stats.record(5.0)  # 5th sample -> slot 4 % 4 == 0
+    assert stats.samples == [5.0, 2.0, 3.0, 4.0]
+    stats.record(6.0)
+    assert stats.samples == [5.0, 6.0, 3.0, 4.0]
+    # a full extra lap replaces everything — no immortal slot
+    for v in (7.0, 8.0, 9.0, 10.0):
+        stats.record(v)
+    assert sorted(stats.samples) == [7.0, 8.0, 9.0, 10.0]
+    assert stats.count == 10
+
+
+def test_percentiles_after_wraparound():
+    stats = LatencyStats(capacity=8)
+    for v in range(100):
+        stats.record(float(v))
+    report = stats.report()
+    # reservoir holds exactly the last 8 samples: 92..99
+    assert report["count"] == 100
+    assert report["p50_ms"] >= 92.0
+    assert report["p99_ms"] == 99.0
+
+
+def test_report_under_concurrent_recording():
+    """report() snapshots count/errors/samples under the lock; hammer it
+    concurrently and require internally consistent output."""
+    stats = LatencyStats(capacity=32)
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            stats.record(float(i % 50))
+            if i % 7 == 0:
+                stats.record_error()
+            i += 1
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            report = stats.report()
+            if report["count"]:
+                assert report["p50_ms"] is not None
+                assert 0.0 <= report["p50_ms"] <= 49.0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    final = stats.report()
+    assert final["count"] > 0 and final["errors"] > 0
