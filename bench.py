@@ -269,6 +269,148 @@ def _stage_decode8b() -> int:
     return 0
 
 
+def shared_prefix_record(*, n_requests: int = 8, prefix_len: int = 512,
+                         suffix_len: int = 16, n_new: int = 16,
+                         block: int = 64, extra: dict | None = None) -> dict:
+    """Shared-prefix serving workload: ``n_requests`` prompts sharing one
+    ``prefix_len``-token prefix (distinct suffixes), run with the
+    automatic prefix cache OFF (full-prompt prefill per request) and ON
+    (radix-matched, suffix-only continuation). Reports measured wall /
+    tok/s / time-to-first-token for both, asserts TOKEN PARITY between
+    the two runs, and attaches the roofline model's analytic prefill
+    FLOP counts — the headline is ``prefill_flop_ratio``: how many times
+    fewer prefill FLOPs the cache-on run executes. CPU-runnable at the
+    default tiny dims (the parity + ratio claims are platform-free)."""
+    import statistics
+
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.runtime.prefixstore import PrefixStore
+    from lambdipy_tpu.utils import roofline
+
+    dims = {"vocab_size": 2048, "hidden": 128, "layers": 2, "heads": 4,
+            "kv_heads": 2, "mlp": 256,
+            "max_len": max(1024, 2 * (prefix_len + suffix_len + n_new))}
+    dims.update(extra or {})
+    adapter = registry.get("llama3-8b").build(dtype="float32", extra=dims)
+    cfg = adapter.config
+    params = jax.device_put(adapter.init_params(seed=0))
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, prefix_len).tolist()
+    rows = [shared + rng.integers(1, cfg.vocab_size, suffix_len).tolist()
+            for _ in range(n_requests)]
+    # warm traffic: same shapes, disjoint tokens — compiles every program
+    # both paths need without seeding the store with the workload prefix
+    warm_row = rng.integers(1, cfg.vocab_size,
+                            prefix_len + suffix_len).tolist()
+
+    def ttft(server, row, prefix=None):
+        t0 = time.monotonic()
+        next(iter(server.generate_stream(row, max_new_tokens=n_new,
+                                         segment=4, prefix=prefix)))
+        return (time.monotonic() - t0) * 1e3
+
+    # -- cache OFF: every request prefills its whole prompt ------------------
+    server_off = adapter.make_server(params)
+    server_off.generate(warm_row, max_new_tokens=n_new)
+    ttft(server_off, warm_row)
+    t0 = time.monotonic()
+    off_out = [server_off.generate(r, max_new_tokens=n_new) for r in rows]
+    off_s = time.monotonic() - t0
+    off_ttft = [ttft(server_off, r) for r in rows]
+
+    # -- cache ON: radix match, suffix-only continuation ---------------------
+    server_on = adapter.make_server(params)
+    store = PrefixStore(server_on, block=block, budget_mb=64)
+    m_warm = store.route(warm_row)
+    server_on.generate(warm_row[m_warm:], prefix=warm_row[:m_warm],
+                       max_new_tokens=n_new)
+    ttft(server_on, warm_row[m_warm:], prefix=warm_row[:m_warm])
+
+    def on_generate(row):
+        m = store.route(row)
+        if m <= 0:
+            return server_on.generate(row, max_new_tokens=n_new)
+        return server_on.generate(row[m:], prefix=row[:m],
+                                  max_new_tokens=n_new)
+
+    t0 = time.monotonic()
+    on_out = [on_generate(row) for row in rows]
+    on_s = time.monotonic() - t0
+
+    def on_ttft(row):
+        m = store.match_len(row)
+        t0 = time.monotonic()
+        next(iter(server_on.generate_stream(
+            row[m:], max_new_tokens=n_new, segment=4,
+            prefix=row[:m] if m else None)))
+        return (time.monotonic() - t0) * 1e3
+
+    on_ttfts = [on_ttft(r) for r in rows]
+
+    parity = all(np.array_equal(a, b) for a, b in zip(off_out, on_out))
+    if not parity:
+        # the docstring's promise is load-bearing: a parity regression
+        # must fail the bench loudly (nonzero rc), not ride out as a
+        # field only pytest wrappers read
+        raise AssertionError("shared-prefix parity broke: cache-on "
+                             "tokens != cache-off tokens")
+    matched = store.match_len(rows[0])
+    # analytic prefill FLOPs: OFF pays the full prompt per request; ON
+    # pays ONE cold radix walk (= one full prefill of the shared blocks)
+    # plus a suffix-only continuation per request
+    flops_off = n_requests * roofline.llama_prefill_cost(
+        cfg, batch=1, seq_len=len(rows[0])).flops
+    flops_on = roofline.llama_prefill_cost(
+        cfg, batch=1, seq_len=matched).flops
+    for row in rows:
+        m = store.match_len(row)
+        flops_on += roofline.llama_prefix_continue_cost(
+            cfg, suffix_len=len(row) - m, prefix_len=m).flops
+    total_new = n_requests * n_new
+    return {
+        "mode": "shared_prefix",
+        "platform": jax.devices()[0].platform,
+        "n_requests": n_requests,
+        "prefix_len": prefix_len,
+        "suffix_len": suffix_len,
+        "n_new": n_new,
+        "block": store.block,
+        "parity": parity,
+        "off_tok_s": round(total_new / off_s, 1),
+        "on_tok_s": round(total_new / on_s, 1),
+        "speedup": round(off_s / on_s, 3),
+        "off_ttft_p50_ms": round(statistics.median(off_ttft), 2),
+        "on_ttft_p50_ms": round(statistics.median(on_ttfts), 2),
+        "prefill_flops_off": flops_off,
+        "prefill_flops_on": flops_on,
+        "prefill_flop_ratio": round(flops_off / flops_on, 2),
+        "prefix_cache": store.stats(),
+    }
+
+
+def _shared_prefix_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shared-prefix", action="store_true")
+    ap.add_argument("--prefix-len", type=int, default=512)
+    ap.add_argument("--suffix-len", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--n-new", type=int, default=16)
+    ap.add_argument("--block", type=int, default=64)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(shared_prefix_record(
+        n_requests=args.requests, prefix_len=args.prefix_len,
+        suffix_len=args.suffix_len, n_new=args.n_new, block=args.block)))
+    return 0
+
+
 def _attach_last_device_record(result: dict) -> None:
     """Best-effort: copy the latest published on-chip measurements from
     BASELINE.json into a CPU-fallback bench line."""
@@ -337,6 +479,11 @@ def _run_stage(stage: str, env: dict, platform: str):
 
 
 def main() -> int:
+    if "--shared-prefix" in sys.argv:
+        # in-process workload mode (no staged orchestration): the
+        # shared-prefix serving comparison is CPU-runnable and prints
+        # one JSON line like every other bench mode
+        return _shared_prefix_main()
     if "--stage" in sys.argv:
         stage = sys.argv[sys.argv.index("--stage") + 1]
         return {"devices": _stage_devices, "matmul": _stage_matmul,

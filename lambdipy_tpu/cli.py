@@ -450,11 +450,27 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "x-api-key/x-tenant; 0 = unlimited)")
 @click.option("--sched-burst", type=float, default=None,
               help="per-tenant token-bucket burst (default 2x rate)")
+@click.option("--prefix-cache-mb", type=float, default=None,
+              help="HBM budget (MB) for the automatic cross-request "
+                   "prefix KV cache; 0 disables, explicit value also "
+                   "opts kv_quant bundles in (default: bundle "
+                   "prefix_cache_mb, else 512)")
+@click.option("--prefix-block", type=int, default=None,
+              help="token-block granularity of prefix reuse (rounded "
+                   "to a pow-2 dividing the context window; default 32)")
 def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
-              sched_queue_cap, sched_rate, sched_burst):
+              sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
+              prefix_block):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
+    # the generate handler builds its prefix store INSIDE load_bundle,
+    # before this process's server object exists — the CLI choice
+    # reaches it through the environment, like LAMBDIPY_SCHED_POLICY
+    if prefix_cache_mb is not None:
+        os.environ["LAMBDIPY_PREFIX_CACHE_MB"] = str(prefix_cache_mb)
+    if prefix_block is not None:
+        os.environ["LAMBDIPY_PREFIX_BLOCK"] = str(prefix_block)
     # BundleServer resolves the effective policy (bundle extra <
     # LAMBDIPY_SCHED_POLICY env < these flags) and bridges it to the
     # handler's batch formation itself — no env plumbing needed here

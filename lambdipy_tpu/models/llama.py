@@ -455,6 +455,47 @@ def init_decode_cache(cfg: LlamaConfig, batch: int, max_len: int):
             for _ in range(cfg.layers)]
 
 
+def slice_cache_blocks(cache, start: int, width: int):
+    """Store-layout ``[start, start + width)`` sequence slices of a decode
+    cache, one dict per layer (``index`` dropped) — the block-granular
+    unit the radix prefix store (runtime/prefixstore.py) keeps. Slices
+    are fresh buffers, so they stay valid when the source cache is later
+    donated to an extension program."""
+    return [{name: jax.lax.dynamic_slice_in_dim(val, start, width, 1)
+             for name, val in entry.items() if name != "index"}
+            for entry in cache]
+
+
+def concat_cache_blocks(cfg: LlamaConfig, blocks, cache_len: int):
+    """Assemble per-layer block slices (as :func:`slice_cache_blocks`
+    returns, one list entry per block, in sequence order) back into a
+    full ``cache_len`` decode cache with ``index`` = total assembled
+    width — the inverse of slicing at block boundaries. KV values are
+    position-dependent (RoPE is applied before the cache store), so the
+    caller must place blocks at the absolute positions they were sliced
+    from; a radix path does that by construction."""
+    total = sum(next(iter(b[0].values())).shape[1] for b in blocks)
+    out = []
+    for i in range(cfg.layers):
+        dest = _empty_cache_entry(cfg, 1, cache_len)
+        for name in blocks[0][i]:
+            merged = jnp.concatenate([b[i][name] for b in blocks], axis=1)
+            dest[name] = jax.lax.dynamic_update_slice(
+                dest[name], merged.astype(dest[name].dtype), (0, 0, 0, 0))
+        dest["index"] = jnp.int32(total)
+        out.append(dest)
+    return out
+
+
+def copy_cache(cache):
+    """Fresh-buffer copy of a decode cache: safe to feed a DONATING
+    program (``_prefix_ext_fn``) while the original stays live in a
+    shared store — donation would otherwise invalidate the stored
+    buffers under every reader."""
+    return [{name: jnp.copy(val) for name, val in entry.items()}
+            for entry in cache]
+
+
 def prefill_into_cache(cfg: LlamaConfig, prefill_cache, batch: int, max_len: int,
                        prompt_len: int):
     """Embed a prefill cache (float entries sized prompt_len) into a
@@ -1410,6 +1451,30 @@ class LlamaServer:
             with self._prefix_lock:
                 self._prefix_inflight.pop(key).set()
 
+    def get_prefix(self, key: str):
+        """LRU-refreshing peek: ``(cache, length)`` for an exact prefix
+        key, or None — never prefills (the radix prefix store's fast
+        path; :meth:`cache_prefix` is the prefill-on-miss sibling)."""
+        with self._prefix_lock:
+            entry = self._prefixes.get(key)
+            if entry is not None:
+                self._prefixes.move_to_end(key)
+            return entry
+
+    def register_prefix(self, key: str, cache, length: int) -> None:
+        """Insert an externally built full-window prefix cache under
+        ``key`` (same LRU bound as :meth:`cache_prefix`) — the radix
+        prefix store's injection point: it assembles a cache from its
+        block slices (or finishes an extension walk) and registers it
+        here so every existing ``prefix=`` path — fused, streaming,
+        continuous-engine join, speculative — serves from it
+        unchanged."""
+        with self._prefix_lock:
+            self._prefixes[key] = (cache, int(length))
+            self._prefixes.move_to_end(key)
+            while len(self._prefixes) > self._prefix_cache_max:
+                self._prefixes.popitem(last=False)
+
     def _prefix_first_fn(self, sb: int, cache_len: int):
         """First-chunk prefix prefill: embed the (padded) chunk into a
         full-window cache, index = true length."""
@@ -1491,10 +1556,7 @@ class LlamaServer:
                 pf_fn = self._prefix_first_fn(sb, cache_len)
                 prompt_op, _ = self._pad_rows(rows, lengths, 1, sb)
                 cache = pf_fn(self.params, prompt_op, jnp.int32(s))
-        with self._prefix_lock:
-            self._prefixes[key] = (cache, s)
-            while len(self._prefixes) > self._prefix_cache_max:
-                self._prefixes.popitem(last=False)
+        self.register_prefix(key, cache, s)
         return key
 
     def _prefix_entry(self, prefix_tokens):

@@ -99,6 +99,10 @@ class ContinuousBatcher:
         self.requests_served = 0
         self.prefill_groups = 0      # engine-side grouped prefill calls
         self.rows_group_prefilled = 0
+        # rows that joined the engine FROM a cached prefix KV (explicit
+        # prefix= or the automatic radix store): suffix-only
+        # continuation carries packed into the shared batch
+        self.prefix_joins = 0
 
     # -- device helpers ------------------------------------------------------
 
@@ -203,13 +207,23 @@ class ContinuousBatcher:
         8-joiner burst against ~1 s of actual decode (round 5's
         concurrent measurement initially published that compile wall as
         a 0.3x engine "slowdown"). One program per power-of-two joiner
-        count 2..slots at the short-prompt bucket (group prefill only
-        ever sees prompts <= group_prefill_max; the min bucket is the
-        dominant family). Each program lands in the server's stream-pair
-        AOT store on the next ``aot_save_all``, so later boots preload
-        them instead of compiling at all. Returns programs touched;
-        meant for the handler's background warm daemon, never the boot
+        count 2..slots at the short-prompt bucket (the min bucket is
+        the dominant family), PLUS one program at the longest prompt
+        bucket group prefill can see (the ``group_prefill_max`` bucket,
+        clamped to what the engine cache admits) at the full-burst
+        joiner count — without it a burst of long-ish prompts paid the
+        cliff the warm exists to remove (ADVICE r5). Residual cliff,
+        deliberate: prompt buckets BETWEEN the min and the max family
+        (e.g. 32/64/128 under a 256 cap) still compile at first use —
+        warming every (count, bucket) pair is quadratic in programs and
+        warm wall-time, and the two endpoints cover the dominant
+        traffic. Each program lands in the server's stream-pair AOT
+        store on the next ``aot_save_all``, so later boots preload them
+        instead of compiling at all. Returns programs touched; meant
+        for the handler's background warm daemon, never the boot
         path."""
+        from lambdipy_tpu.models.llama import _next_bucket
+
         counts = []
         bb = 2
         while bb <= self.slots:
@@ -221,8 +235,6 @@ class ContinuousBatcher:
             counts.append(self.slots)
         seen = set()
         for count in counts:
-            from lambdipy_tpu.models.llama import _next_bucket
-
             if (key := _next_bucket(count, 1)) in seen:
                 continue
             seen.add(key)
@@ -230,7 +242,21 @@ class ContinuousBatcher:
                             top_k=None, top_p=None, seed=None)
                        for _ in range(count)]
             self._prefill_group(entries)
-        return len(seen)
+        n = len(seen)
+        # the long-prompt family: one warm at the largest joiner bucket.
+        # Rows must still be engine-admittable (s + max_new <= cache_len)
+        # so a realistic long group prompt tops out near half the cache.
+        s_warm = min(self.group_prefill_max, max(1, self.cache_len // 2))
+        min_sb = _next_bucket(3, self.server.min_bucket)
+        warm_sb = _next_bucket(s_warm, self.server.min_bucket)
+        if counts and warm_sb != min_sb:
+            row = list(range(1, s_warm + 1))
+            entries = [dict(row=row, s=s_warm, temperature=None,
+                            top_k=None, top_p=None, seed=None)
+                       for _ in range(max(counts))]
+            self._prefill_group(entries)
+            n += 1
+        return n
 
     def _prefill_row_chunked(self, row, s: int, entry: dict):
         """Long-prompt joiner prefill through fixed-width chunks: each
@@ -468,6 +494,8 @@ class ContinuousBatcher:
                 return None
             entry["carry"] = self._prefill_prefix_row(prefix, row, s,
                                                       entry, pentry)
+            with self._lock:
+                self.prefix_joins += 1
         else:
             if s + max_new_tokens > self.cache_len:
                 # a request over the engine's (operator-capped)
@@ -612,5 +640,6 @@ class ContinuousBatcher:
                     "requests_served": self.requests_served,
                     "prefill_groups": self.prefill_groups,
                     "rows_group_prefilled": self.rows_group_prefilled,
+                    "prefix_joins": self.prefix_joins,
                     "active_rows": active,
                     "waiting_joiners": len(self._joiners)}

@@ -28,6 +28,11 @@ class HandlerState:
     # optional streaming invoke: request -> iterator of chunk dicts,
     # last one carrying {"done": true}. None = handler can't stream.
     invoke_stream_fn: Callable[[dict], Any] | None = None
+    # optional host-only probe: prompt token ids -> tokens the automatic
+    # prefix cache would reuse. The HTTP scheduler prices admission on
+    # the SUFFIX a request will actually prefill (runtime/server.py) —
+    # without this, deadline shedding over-rejects cache-hit requests.
+    prefix_probe: Callable[[Any], int] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
@@ -404,6 +409,42 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                                    max_batch=int(extra.get("batch_max", 8)),
                                    policy=sched_policy)
 
+    # automatic cross-request prefix KV cache (runtime/prefixstore.py):
+    # the DEFAULT path for all single-row generate requests — the prompt
+    # is longest-prefix-matched against a radix tree of cached KV blocks
+    # and only the suffix prefills. `prefix_cache_mb` (bundle extra, or
+    # `lambdipy serve --prefix-cache-mb` via the env bridge) budgets the
+    # store's HBM; 0 disables. kv_quant bundles keep it OPT-IN: the
+    # cached prefix reads back quantized, so on/off parity drops from
+    # bitwise to quantization tolerance — the operator must choose that.
+    prefix_store = None
+    # configurations where routing permanently stands down must not
+    # build (or advertise) a store at all: meta would claim the cache is
+    # on, /metrics would export counters that can never move, and every
+    # admission would probe a permanently empty tree
+    routable = (batcher is None
+                or (continuous is not None
+                    and server is not None
+                    and continuous.cache_len == server.model.cfg.max_len))
+    if server is not None and routable:
+        import os as _os_px
+
+        raw_mb = _os_px.environ.get("LAMBDIPY_PREFIX_CACHE_MB")
+        if raw_mb in (None, ""):
+            raw_mb = extra.get("prefix_cache_mb")
+        raw_block = _os_px.environ.get("LAMBDIPY_PREFIX_BLOCK")
+        if raw_block in (None, ""):
+            raw_block = extra.get("prefix_block")
+        explicit_mb = raw_mb not in (None, "")
+        mb = float(raw_mb) if explicit_mb else 512.0
+        if mb > 0 and (server.model.cfg.kv_quant is None or explicit_mb):
+            from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+            prefix_store = PrefixStore(
+                server,
+                block=int(raw_block) if raw_block not in (None, "") else 32,
+                budget_mb=mb)
+
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a
     # multi-second compile at request time (measured ~14 s for a
@@ -489,6 +530,35 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 str(resolved), local_files_only=True)
         except Exception as e:  # noqa: BLE001 - degrade, recorded in meta
             tok_err = str(e)
+
+    def _route_prefix(prompt, prefix):
+        """Transparent radix reuse: split a single-row prompt into
+        (suffix prompt, cached-prefix tokens) when the prefix store can
+        match or extend a block-aligned prefix. Requests carrying an
+        EXPLICIT ``prefix`` keep the client's split; multi-row and
+        sub-block prompts pass through. Fail-open by construction —
+        ``route`` returns 0 on any store failure."""
+        if prefix_store is None or prefix is not None or len(prompt) != 1:
+            return prompt, prefix
+        if continuous is not None and \
+                continuous.cache_len != server.model.cfg.max_len:
+            # a capped engine can't pack full-window prefix carries
+            # (continuous._admit falls back solo): auto-routing would
+            # silently trade away continuous batching for KV reuse —
+            # keep the engine's pre-cache behavior and skip routing
+            return prompt, prefix
+        if batcher is not None and continuous is None:
+            # MicroBatcher mode: prefix requests bypass the window
+            # batcher entirely (it has no prefix path), so routing would
+            # serialize exactly the concurrent traffic the batcher
+            # fuses — same silent-trade regression, same stand-down
+            return prompt, prefix
+        row = [int(t) for t in np.asarray(prompt[0]).reshape(-1)]
+        m = prefix_store.route(row)
+        if m <= 0:
+            return prompt, prefix
+        return ([np.asarray(row[m:], np.int32)],
+                np.asarray(row[:m], np.int32))
 
     def run(prompt, max_new, sample_kwargs, want_lp=False):
         # prompt stays a host numpy array until the chosen path needs it:
@@ -645,6 +715,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     def _invoke_parsed(parsed) -> dict:
         (prompt, max_new, sample_kwargs, from_text, prefix, want_lp,
          spec_k) = parsed
+        prompt, prefix = _route_prefix(prompt, prefix)
         lps = None
         if want_lp and server is None:
             return {"ok": False,
@@ -717,6 +788,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             return
         (prompt, max_new, sample_kwargs, from_text, prefix, want_lp,
          spec_k) = parsed
+        prompt, prefix = _route_prefix(prompt, prefix)
         # clamp the client's segment size to a pow-2 in [4, 64]: it is
         # part of the compiled-program key, and an arbitrary per-request
         # value would grow the program cache (and pay a compile) without
@@ -829,9 +901,17 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 "seconds": preload_state.get("seconds")}
         if batcher is not None:
             out["batching"] = batcher.stats()
-        if any(warm_state.values()):  # listed buckets OR the engine's
-            # group-prefill warm — snapshot under the lock: the warm
-            # daemon appends to these lists while we serialize them
+        if prefix_store is not None:
+            # prefix_cache_{hits,misses,hit_tokens,evictions,bytes} +
+            # hit_rate — the automatic radix reuse surface
+            out["prefix_cache"] = prefix_store.stats()
+        if warm_state["requested"] or warm_group:
+            # gate on what was ASKED (listed buckets or the engine's
+            # group-prefill warm), not on what finished: an in-flight
+            # warm with empty done/errors lists must still be visible
+            # in /metrics, or operators can't tell "running" from "not
+            # started" (ADVICE r5). Snapshot under the lock: the warm
+            # daemon appends while we serialize.
             with _warm_lock:
                 out["warm_buckets"] = {
                     k: list(v) if isinstance(v, list) else v
@@ -841,11 +921,14 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     return HandlerState(
         invoke_fn=invoke, stats_fn=stats,
         invoke_stream_fn=invoke_stream if server is not None else None,
+        prefix_probe=(prefix_store.match_len
+                      if prefix_store is not None else None),
         meta={
             "model": spec["model"], "quant": spec.get("quant"),
             "sharded": mesh is not None, "tokenizer": tokenizer is not None,
             "compile_once": server is not None,
             "streaming": server is not None,
+            "prefix_cache": prefix_store is not None,
             **({"tokenizer_error": tok_err} if tok_err else {}),
         })
 

@@ -2,7 +2,9 @@
 
 SURVEY.md §6 metrics row: the reference has stdout echo only; the rebuild
 keeps p50/p99 and cold-start stage timings as first-class, exported on
-``/metrics`` as JSON.
+``/metrics`` as JSON. :class:`PrefixCacheStats` is the counter block the
+automatic prefix KV cache (runtime/prefixstore.py) publishes under
+``handler.prefix_cache``.
 """
 
 from __future__ import annotations
@@ -63,3 +65,53 @@ class LatencyStats:
             "p90_ms": self._percentile(samples, 90),
             "p99_ms": self._percentile(samples, 99),
         }
+
+
+@dataclass
+class PrefixCacheStats:
+    """Counters for the automatic cross-request prefix KV cache: a
+    request whose prompt longest-prefix-matches the radix tree is a hit
+    (``hit_tokens`` = prompt tokens whose prefill was skipped), one with
+    cacheable length but no match is a miss. ``bytes``/``blocks`` track
+    what the store currently holds against its HBM budget; ``evictions``
+    counts blocks dropped by the budget's LRU sweep."""
+
+    hits: int = 0
+    misses: int = 0
+    hit_tokens: int = 0
+    evictions: int = 0
+    bytes: int = 0
+    blocks: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_request(self, matched_tokens: int) -> None:
+        with self._lock:
+            if matched_tokens > 0:
+                self.hits += 1
+                self.hit_tokens += matched_tokens
+            else:
+                self.misses += 1
+
+    def record_insert(self, n_blocks: int, nbytes: int) -> None:
+        with self._lock:
+            self.blocks += n_blocks
+            self.bytes += nbytes
+
+    def record_evict(self, n_blocks: int, nbytes: int) -> None:
+        with self._lock:
+            self.blocks -= n_blocks
+            self.bytes -= nbytes
+            self.evictions += n_blocks
+
+    def report(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+                "hit_tokens": self.hit_tokens,
+                "evictions": self.evictions,
+                "bytes": self.bytes,
+                "blocks": self.blocks,
+            }

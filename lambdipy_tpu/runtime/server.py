@@ -46,23 +46,37 @@ from lambdipy_tpu.utils.logs import get_logger, log_event
 log = get_logger("lambdipy.server")
 
 
-def _request_token_counts(request: dict | None) -> tuple[int, int]:
+def _request_token_counts(request: dict | None,
+                          prefix_probe=None) -> tuple[int, int]:
     """Best-effort (prefill, decode) token counts for the cost estimator:
     wrong-shaped fields count as zero — sizing is advisory, validation
-    belongs to the handler."""
+    belongs to the handler.
+
+    ``prefix_probe`` is the handler's automatic-prefix-cache probe
+    (prompt ids -> tokens the radix store would reuse): admission prices
+    the SUFFIX a cache-hit request will actually prefill, not the full
+    prompt — otherwise deadline shedding keeps rejecting exactly the
+    requests the cache makes cheap."""
     if not isinstance(request, dict):
         return 0, 0
     prefill = 0
     toks = request.get("tokens")
+    flat_row = None
     if isinstance(toks, (list, tuple)):
         if toks and isinstance(toks[0], (list, tuple)):
             prefill = sum(len(r) for r in toks
                           if isinstance(r, (list, tuple)))
         else:
             prefill = len(toks)
+            flat_row = toks
     prefix = request.get("prefix")
     if isinstance(prefix, (list, tuple)):
         prefill += len(prefix)
+    elif prefix_probe is not None and flat_row is not None and prefill:
+        try:
+            prefill = max(0, prefill - int(prefix_probe(flat_row)))
+        except Exception:  # noqa: BLE001 — pricing is advisory
+            pass
     decode = 0
     for key in ("max_new_tokens", "max_tokens"):
         raw = request.get(key)
@@ -310,7 +324,10 @@ class BundleServer:
                     self._send_shed(Shed(503, "draining", 1.0),
                                     openai=openai)
                     return None
-                prefill, decode = _request_token_counts(request)
+                prefill, decode = _request_token_counts(
+                    request,
+                    prefix_probe=getattr(server_self.boot.state,
+                                         "prefix_probe", None))
                 out = server_self.sched.admit(
                     tenant=tenant, cls=cls, deadline_ms=deadline_ms,
                     prefill_tokens=prefill, decode_tokens=decode)

@@ -138,6 +138,31 @@ def llama_prefill_cost(cfg, *, batch: int, seq_len: int) -> Cost:
     return Cost(float(flops), float(hbm))
 
 
+def llama_prefix_continue_cost(cfg, *, suffix_len: int,
+                               prefix_len: int) -> Cost:
+    """Cost of a suffix-only continuation prefill from a cached prefix
+    KV: ``suffix_len`` new tokens run the matmul stack once and attend
+    ``prefix_len`` cached positions plus their own causal window
+    (lm_head at one position, matching ``_continue_prefill``). The
+    shared-prefix serving win is this against
+    :func:`llama_prefill_cost` of the full ``prefix_len + suffix_len``
+    prompt. Bytes: weights once, the cached prefix KV read, the
+    suffix's KV written."""
+    h = cfg.hidden
+    per_layer_matmul = (h * h + 2 * h * cfg.kv_heads * cfg.head_dim
+                        + h * h + 3 * h * cfg.mlp)
+    # q.k^T + attn.v over the cached prefix (full rectangle) plus the
+    # suffix's own causal triangle (same halved convention as
+    # llama_prefill_cost)
+    attn = cfg.layers * (4 * h * suffix_len * prefix_len
+                         + 2 * h * suffix_len * suffix_len)
+    flops = (2 * suffix_len * cfg.layers * per_layer_matmul + attn
+             + 2 * h * cfg.vocab_size)
+    hbm = (llama_weight_bytes(cfg)
+           + (prefix_len + suffix_len) * llama_kv_bytes_per_pos(cfg))
+    return Cost(float(flops), float(hbm))
+
+
 # ResNet-50 v1.5 forward at 224x224: ~4.09 GFLOPs/image (standard count,
 # MAC=2 FLOPs), 25.6M params.
 RESNET50_FLOPS_PER_IMAGE = 4.09e9

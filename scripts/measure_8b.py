@@ -755,7 +755,10 @@ def _publish(update) -> None:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", default="1,8")
-    ap.add_argument("--n-new", type=int, default=64)
+    # None = "flag omitted": modes pick their own default (64, except
+    # kv-quant's 128) and an EXPLICIT --n-new always wins — keying the
+    # kv-quant override on the default value made --n-new 64 unreachable
+    ap.add_argument("--n-new", type=int, default=None)
     ap.add_argument("--cold-start", action="store_true",
                     help="measure the build->deploy->invoke cold start "
                          "instead of decode throughput")
@@ -776,6 +779,7 @@ def main() -> int:
     ap.add_argument("--publish", action="store_true",
                     help="record into BASELINE.json published.config5")
     args = ap.parse_args()
+    n_new = 64 if args.n_new is None else args.n_new
     if args.prefill_table:
         record = measure_prefill()
         print(json.dumps(record, indent=2))
@@ -785,21 +789,22 @@ def main() -> int:
     if args.kv_quant:
         # the differenced signal is (n_new/2) decode steps; 128 doubles
         # it vs the shared 64 default without moving the ~1k window much
+        # — but only when --n-new was OMITTED (an explicit value wins)
         record = measure_kv_quant(
-            n_new=128 if args.n_new == 64 else args.n_new)
+            n_new=128 if args.n_new is None else args.n_new)
         print(json.dumps(record, indent=2))
         if args.publish:
             _publish(lambda pub, c5: c5.__setitem__("kv_int8", record))
         return 0
     if args.concurrent:
         record = measure_concurrent(n_requests=args.n_requests,
-                                    n_new=args.n_new)
+                                    n_new=n_new)
         print(json.dumps(record, indent=2))
         if args.publish:
             _publish(lambda pub, c5: c5.__setitem__("concurrent", record))
         return 0
     if args.speculative:
-        record = measure_speculative(n_new=args.n_new, k=args.k)
+        record = measure_speculative(n_new=n_new, k=args.k)
         print(json.dumps(record, indent=2))
         if args.publish:
             _publish(lambda pub, c5: c5.__setitem__("speculative", record))
@@ -814,7 +819,7 @@ def main() -> int:
                  if k not in ("dims", "measured_at")}))
         return 0
     batches = tuple(int(b) for b in args.batch.split(","))
-    record = measure(batches=batches, n_new=args.n_new)
+    record = measure(batches=batches, n_new=n_new)
     print(json.dumps(record, indent=2))
     if args.publish:
         def replace(pub, c5):

@@ -13,7 +13,10 @@ mkdir -p "$OUT"
 DEADLINE=$(( $(date +%s) + ${DEADLINE_HOURS:-7}*3600 ))
 
 probe() {
-  timeout 120 python -c "
+  # -k 10: SIGKILL follows SIGTERM — a child stuck in an uninterruptible
+  # device syscall (the wedge this script exists for) survives SIGTERM
+  # and would otherwise hang the probe loop itself
+  timeout -k 10 120 python -c "
 import jax, jax.numpy as jnp
 d = jax.devices()
 assert d[0].platform == 'tpu', d

@@ -6,7 +6,12 @@
 # Python 3.10) must turn the build red by itself, not hide behind
 # --continue-on-collection-errors in the main run.
 #
-# Phase 2 is the EXACT tier-1 command from ROADMAP.md.
+# Phase 2 is the EXACT tier-1 command from ROADMAP.md (its exit code
+# still gates; the only change is that success falls through to phase 3
+# instead of exiting inline).
+#
+# Phase 3 is a quick forced-CPU bench.py smoke (tiny model) so a bench
+# orchestration regression turns tier-1 red, not measurement day.
 
 set -u
 cd "$(dirname "$0")/.."
@@ -22,4 +27,17 @@ if grep -qE '^ERROR |[0-9]+ errors? in ' /tmp/_t1_collect.log; then
 fi
 
 echo "== phase 2: tier-1 suite (ROADMAP.md verbatim) =="
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Phase 3: a quick CPU bench smoke — the staged orchestration (tiny
+# model, forced-cpu attempt) end to end, so a bench.py regression turns
+# tier-1 red instead of surfacing at measurement time. rc != 0 fails.
+echo "== phase 3: bench.py CPU smoke =="
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    LAMBDIPY_BENCH_FORCE_PLATFORM=cpu LAMBDIPY_BENCH_MODEL=resnet50-tiny \
+    python bench.py; then
+    echo "FATAL: bench.py CPU smoke failed" >&2
+    exit 1
+fi
+exit 0

@@ -482,7 +482,7 @@ def test_warm_group_prefill_precompiles_burst_programs(tiny_server):
     a remote-compile transport the unwarmed first burst paid ~30 s of
     compiles inside request latency (round-5 concurrent measurement)."""
     cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
-    assert cb.warm_group_prefill() == 2  # bb = 2, 4
+    assert cb.warm_group_prefill() == 3  # bb = 2, 4 + the long bucket
     before = tiny_server.compile_count
     for k in (2, 3, 4):  # 3 rides the bb=4 bucket
         entries = [dict(row=[5, 6], s=2, temperature=None, top_k=None,
@@ -490,6 +490,27 @@ def test_warm_group_prefill_precompiles_burst_programs(tiny_server):
         cb._prefill_group(entries)
     assert tiny_server.compile_count == before, \
         "burst group-prefill must reuse the warmed programs"
+
+
+@pytest.mark.slow  # one extra 4x64 prefill compile; the warm COUNTS
+# (which include the long bucket) are asserted non-slow above/below
+def test_warm_group_prefill_covers_long_prompt_bucket(tiny_server):
+    """Prompts above the min bucket used to stay a residual compile
+    cliff (ADVICE r5 continuous.py:222): the warm now also compiles the
+    longest group-prefillable prompt bucket at the full-burst joiner
+    count, so a burst of long-ish prompts compiles nothing. Prompt
+    buckets BETWEEN the two warmed families still compile at first use
+    — that residual is documented in warm_group_prefill's docstring."""
+    cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
+    cb.warm_group_prefill()
+    before = tiny_server.compile_count
+    s_warm = min(cb.group_prefill_max, cb.cache_len // 2)
+    entries = [dict(row=list(range(1, s_warm + 1)), s=s_warm,
+                    temperature=None, top_k=None, top_p=None, seed=None)
+               for _ in range(4)]
+    cb._prefill_group(entries)
+    assert tiny_server.compile_count == before, \
+        "a full burst at the long-prompt bucket must hit warm programs"
 
 
 def test_handler_daemon_warms_group_prefill(tmp_path):
@@ -502,8 +523,10 @@ def test_handler_daemon_warms_group_prefill(tmp_path):
     bundle = make_model_bundle(
         tmp_path, model="llama-tiny",
         handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        # explicit: the test helper defaults the warm daemon OFF for
+        # suite economy; this test IS the daemon wiring
         extra={"max_new_tokens": "4", "batch_mode": "continuous",
-               "batch_max": "4"})
+               "batch_max": "4", "warm_group_prefill": "1"})
     r = load_bundle(bundle, warmup=True)
     assert r.warmup_result["ok"]
     deadline = time.monotonic() + 60
@@ -522,7 +545,7 @@ def test_warm_group_prefill_covers_non_pow2_slots(tiny_server):
     (_next_bucket(6) = 8): warm must compile that bucket too, or the
     largest burst pays the compile cliff the warm exists to remove."""
     cb = ContinuousBatcher(tiny_server, slots=6, segment=4)
-    assert cb.warm_group_prefill() == 3  # buckets 2, 4, 8
+    assert cb.warm_group_prefill() == 4  # buckets 2, 4, 8 + long bucket
     before = tiny_server.compile_count
     entries = [dict(row=[5, 6], s=2, temperature=None, top_k=None,
                     top_p=None, seed=None) for _ in range(6)]

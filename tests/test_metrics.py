@@ -1,10 +1,10 @@
 """LatencyStats: percentile edge cases + reservoir wraparound (the seed
 overwrote with the post-increment count, skewing the ring by one and
-making slot 0 immortal)."""
+making slot 0 immortal). Plus the prefix-cache counter block."""
 
 import threading
 
-from lambdipy_tpu.runtime.metrics import LatencyStats
+from lambdipy_tpu.runtime.metrics import LatencyStats, PrefixCacheStats
 
 
 def test_empty_reservoir_reports_none():
@@ -83,3 +83,25 @@ def test_report_under_concurrent_recording():
             t.join()
     final = stats.report()
     assert final["count"] > 0 and final["errors"] > 0
+
+
+def test_prefix_cache_stats_counters():
+    """The /metrics counter block the radix prefix store publishes:
+    hit/miss/hit_tokens accounting, byte/block bookkeeping through
+    insert + evict, and a rate that never divides by zero."""
+    st = PrefixCacheStats()
+    assert st.report() == {"hits": 0, "misses": 0, "hit_rate": 0.0,
+                           "hit_tokens": 0, "evictions": 0, "bytes": 0,
+                           "blocks": 0}
+    st.record_request(0)        # miss
+    st.record_request(64)       # hit, 64 reused tokens
+    st.record_request(32)
+    st.record_insert(2, 8192)
+    st.record_insert(1, 4096)
+    st.record_evict(1, 4096)
+    rep = st.report()
+    assert rep["hits"] == 2 and rep["misses"] == 1
+    assert rep["hit_rate"] == round(2 / 3, 4)
+    assert rep["hit_tokens"] == 96
+    assert rep["blocks"] == 2 and rep["bytes"] == 8192
+    assert rep["evictions"] == 1

@@ -19,9 +19,19 @@ def make_model_bundle(tmp_path, *, model="llama-tiny", handler, extra=None,
     provides jax; payload params initialized at build time). Serving-
     program AOT snapshots default OFF here — every warmed boot would pay
     exports + round-trip compiles on the 1-core box; the feature has its
-    own test (test_aot) and stays default-ON in production bundles."""
+    own test (test_aot) and stays default-ON in production bundles. The
+    automatic prefix cache defaults OFF for the same reason (every
+    33+-token prompt would compile block/continuation programs on the
+    1-core box); it has its own tests (test_prefixstore, which opt in)
+    and stays default-ON in production bundles."""
     extra = dict(extra or ())
     extra.setdefault("serve_aot", "0")
+    extra.setdefault("prefix_cache_mb", "0")
+    # the background group-prefill warm daemon compiles burst programs
+    # CONCURRENTLY with whatever test runs next — pure CPU steal on the
+    # 1-core box; its wiring has its own opt-in test
+    # (test_handler_daemon_warms_group_prefill)
+    extra.setdefault("warm_group_prefill", "0")
     doc = {
         "schema": 1,
         "name": f"test-{model}",
@@ -297,7 +307,11 @@ def test_background_bucket_warm(tmp_path):
     bundle = make_model_bundle(
         tmp_path, model="llama-tiny",
         handler="lambdipy_tpu.runtime.handlers:generate_handler",
-        extra={"max_new_tokens": "4", "warm_buckets": "64"})
+        # the automatic prefix cache would route the 50-token probe into
+        # continuation programs instead of the warmed fused bucket; this
+        # test exercises the bucket-warm machinery, so keep it off
+        extra={"max_new_tokens": "4", "warm_buckets": "64",
+               "prefix_cache_mb": "0"})
     report = load_bundle(bundle, warmup=False)
     # the warm thread starts only after the FIRST invoke completes (so it
     # can never contend with the boot warmup); trigger it
