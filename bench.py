@@ -393,6 +393,115 @@ def shared_prefix_record(*, n_requests: int = 8, prefix_len: int = 512,
     }
 
 
+def decode_window_record(*, lens=(16, 48, 200), cache_len: int = 256,
+                         n_new: int = 24, segment: int = 8,
+                         extra: dict | None = None) -> dict:
+    """Decode-window sweep: rows of different prompt lengths decode to
+    ``n_new`` tokens through (a) the solo full-window dense path and
+    (b) the continuous engine's length-aware window-bucketed segments,
+    asserting TOKEN PARITY per length and that the measured KV-read
+    ``savings_ratio`` (window bytes / full-window bytes, from
+    ``DecodeWindowStats``) scales with the row's actual context —
+    strictly below 1 for short rows and monotone in prompt length. The
+    roofline model's analytic per-step byte counts ride along. CPU-
+    runnable at tiny dims: the parity + scaling claims are platform-free
+    (the engine's XLA window bucketing is what the sweep measures; the
+    TPU blocked kernel's numbers come from scripts/bench_kernels.py)."""
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+    from lambdipy_tpu.utils import roofline
+
+    dims = {"vocab_size": 2048, "hidden": 128, "layers": 2, "heads": 4,
+            "kv_heads": 2, "mlp": 256, "max_len": cache_len}
+    dims.update(extra or {})
+    adapter = registry.get("llama3-8b").build(dtype="float32", extra=dims)
+    cfg = adapter.config
+    params = jax.device_put(adapter.init_params(seed=0))
+    server = adapter.make_server(params)
+
+    rng = np.random.default_rng(0)
+    rows_rec = []
+    ratios = []
+    # the monotonicity assertion below compares ratios in prompt-length
+    # order — sort so an unsorted --lens can't masquerade as a regression
+    lens = sorted(lens)
+    for L in lens:
+        if L + n_new > cache_len:
+            raise ValueError(f"len {L} + n_new {n_new} exceeds cache_len")
+        row = rng.integers(1, cfg.vocab_size, L).tolist()
+        solo = server.generate(row, max_new_tokens=n_new)
+        # fresh engine per length: its decode-window counters are then
+        # exactly this row's segments
+        engine = ContinuousBatcher(server, slots=2, segment=segment,
+                                   cache_len=cache_len)
+        t0 = time.monotonic()
+        out = engine.generate(row, max_new_tokens=n_new)
+        wall_ms = (time.monotonic() - t0) * 1e3
+        if not np.array_equal(solo, out):
+            raise AssertionError(
+                f"decode-window parity broke at prompt len {L}: windowed "
+                "engine tokens != dense solo tokens")
+        win = engine.stats()["decode_window"]
+        # analytic bytes at the mean decode position, full window vs the
+        # sweep's mean dispatched window
+        mean_pos = L + n_new // 2
+        full_cost = roofline.llama_decode_step_cost(
+            cfg, batch=1, cache_len=cache_len)
+        mean_window = (win["window_tokens"] / max(1, n_new))
+        win_cost = roofline.llama_decode_window_cost(
+            cfg, batch=1, window_len=int(mean_window), active_len=mean_pos)
+        rows_rec.append({
+            "prompt_len": L,
+            "savings_ratio": win["savings_ratio"],
+            "attended_ratio": win["attended_ratio"],
+            "buckets": win["buckets"],
+            "wall_ms": round(wall_ms, 1),
+            "kv_bytes_step_full": full_cost.hbm_bytes
+            - roofline.llama_weight_bytes(cfg),
+            "kv_bytes_step_windowed": win_cost.hbm_bytes
+            - roofline.llama_weight_bytes(cfg),
+        })
+        ratios.append(win["savings_ratio"])
+    # the load-bearing claims: short rows SAVE (ratio < 1) and savings
+    # shrink monotonically as the active context approaches the window
+    if not ratios[0] < 1.0:
+        raise AssertionError(
+            f"shortest row saved nothing: savings_ratio={ratios[0]}")
+    if any(a > b for a, b in zip(ratios, ratios[1:])):
+        raise AssertionError(
+            f"savings_ratio not monotone in prompt length: {ratios}")
+    return {
+        "mode": "decode_window",
+        "platform": jax.devices()[0].platform,
+        "cache_len": cache_len,
+        "n_new": n_new,
+        "segment": segment,
+        "parity": True,
+        "rows": rows_rec,
+    }
+
+
+def _decode_window_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--decode-window", action="store_true")
+    ap.add_argument("--cache-len", type=int, default=256)
+    ap.add_argument("--n-new", type=int, default=24)
+    ap.add_argument("--segment", type=int, default=8)
+    ap.add_argument("--lens", type=str, default="16,48,200")
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(decode_window_record(
+        lens=tuple(int(x) for x in args.lens.split(",")),
+        cache_len=args.cache_len, n_new=args.n_new, segment=args.segment)))
+    return 0
+
+
 def _shared_prefix_main() -> int:
     import argparse
 
@@ -484,6 +593,10 @@ def main() -> int:
         # shared-prefix serving comparison is CPU-runnable and prints
         # one JSON line like every other bench mode
         return _shared_prefix_main()
+    if "--decode-window" in sys.argv:
+        # CPU-runnable decode-window sweep: parity + monotone KV-read
+        # savings from the length-aware windowed decode path
+        return _decode_window_main()
     if "--stage" in sys.argv:
         stage = sys.argv[sys.argv.index("--stage") + 1]
         return {"devices": _stage_devices, "matmul": _stage_matmul,

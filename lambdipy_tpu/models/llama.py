@@ -53,7 +53,13 @@ class LlamaConfig:
     # fuses the dequant into the attention einsum.
     kv_quant: str | None = None
     # Attention backend: "dense" (XLA-fused, default), "flash" (Pallas
-    # kernel when shapes tile), or "ring" — the LONG-CONTEXT pair:
+    # kernel when shapes tile), "blocked" (length-aware blocked DECODE
+    # attention, ops/decode_attention.py: single-token decode steps read
+    # KV bytes proportional to each row's actual context instead of the
+    # full static window — per-row active_len early exit on the TPU
+    # kernel, dense-bitwise pure-jax reference elsewhere; prefill and
+    # multi-token chunks stay dense, sharded/sp decode stands down to
+    # the existing path), or "ring" — the LONG-CONTEXT pair:
     # sequence-parallel ring attention for prefill AND sequence-sharded
     # flash-decoding for decode steps over the ambient mesh's sp axis
     # (parallel/ring.py + parallel/spdecode.py; the KV cache never
@@ -371,15 +377,42 @@ class LlamaBlock(nn.Module):
                              <= idx[:, None, None])  # [b, 1, t]
                 new_cache = {name: shard_hint(val, "dp", None, "tp")
                              for name, val in new_cache.items()}
-                if cfg.kv_quant == "int8":
-                    ck = _kv_dequantize(new_cache["k_int8"],
-                                        new_cache["k_scale"], cfg.dtype)
-                    cv = _kv_dequantize(new_cache["v_int8"],
-                                        new_cache["v_scale"], cfg.dtype)
-                else:
-                    ck, cv = new_cache["k"], new_cache["v"]
-                attn_mask = jnp.broadcast_to(valid, (b, s, t))
-                out = _attend(q, ck, cv, attn_mask)
+                # length-aware blocked decode attention: one-token steps
+                # read each row's ACTIVE window instead of the full
+                # static cache (bytes scale with context actually held).
+                # Manual (unpartitioned) op like QDense's pallas backend:
+                # only taken with no ambient mesh; the valid mask built
+                # above is exactly "position < index + 1", so active_len
+                # = idx + 1 reproduces it row for row.
+                blocked = False
+                if cfg.attn_backend == "blocked" and s == 1:
+                    from lambdipy_tpu.ops.decode_attention import (
+                        decode_attention)
+                    from lambdipy_tpu.parallel.mesh import current_mesh
+
+                    if current_mesh() is None:
+                        active = jnp.broadcast_to(
+                            jnp.asarray(idx, jnp.int32) + 1, (b,))
+                        if cfg.kv_quant == "int8":
+                            out = decode_attention(
+                                q, new_cache["k_int8"],
+                                new_cache["v_int8"], active,
+                                k_scale=new_cache["k_scale"],
+                                v_scale=new_cache["v_scale"])
+                        else:
+                            out = decode_attention(
+                                q, new_cache["k"], new_cache["v"], active)
+                        blocked = True
+                if not blocked:
+                    if cfg.kv_quant == "int8":
+                        ck = _kv_dequantize(new_cache["k_int8"],
+                                            new_cache["k_scale"], cfg.dtype)
+                        cv = _kv_dequantize(new_cache["v_int8"],
+                                            new_cache["v_scale"], cfg.dtype)
+                    else:
+                        ck, cv = new_cache["k"], new_cache["v"]
+                    attn_mask = jnp.broadcast_to(valid, (b, s, t))
+                    out = _attend(q, ck, cv, attn_mask)
 
         out = out.reshape(b, s, cfg.heads * d)
         x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, cfg.matmul_backend, name="o_proj")(out)
@@ -1648,6 +1681,48 @@ class LlamaServer:
             return (jax.jit(prefill), jax.jit(seg))
 
         return self._fn_cached(("stream", b, sb, cache_len, segment), build)
+
+    def _windowed_seg_fn(self, b: int, cache_len: int, window: int,
+                         segment: int):
+        """Window-bucketed segment decode for the continuous engine: the
+        program slices the first ``window`` positions of the B-slot
+        cache, runs the segment scan over that NARROW cache — decode
+        attention reads ``window`` positions per step instead of
+        ``cache_len`` — and writes the advanced window back into the
+        full carry. The decode-side twin of prefill's pow-2 bucketing:
+        XLA KV reads scale with the live batch's actual context, no
+        kernel required. Exactness: the engine only dispatches here when
+        every active row's positions stay below ``window`` for the whole
+        segment, and positions past a row's index are masked to exact
+        zeros either way, so tokens are bitwise the full-window
+        program's (asserted in tests). Keyed ("seg_w", ...) in the LRU
+        program cache; deliberately not AOT-able (window buckets are
+        load-dependent — snapshotting every variant would bloat the
+        store for programs that compile in seconds at tiny windows)."""
+        def build():
+            def seg(params, temperature, top_k, top_p, first, lp, cache,
+                    pos, done, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                win = [{name: (val if name == "index"
+                               else jax.lax.slice_in_dim(val, 0, window,
+                                                         axis=1))
+                        for name, val in entry.items()} for entry in cache]
+                (toks, lps), carry = _scan_decode(
+                    self.model, params, select, first, lp, win, pos, done,
+                    rng, eos_id, segment, return_carry=True)
+                f2, lp2, wcache, pos2, done2, rng2 = carry
+                merged = [
+                    {name: (val if name == "index"
+                            else jax.lax.dynamic_update_slice_in_dim(
+                                cache[i][name], val, 0, axis=1))
+                     for name, val in entry.items()}
+                    for i, entry in enumerate(wcache)]
+                return (toks, lps), (f2, lp2, merged, pos2, done2, rng2)
+
+            return jax.jit(seg)
+
+        return self._fn_cached(("seg_w", b, cache_len, window, segment),
+                               build)
 
     def _stream_prefix_fn(self, sbs: int, cache_len: int | None = None):
         """Continue-prefill program for streaming-from-a-cached-prefix:

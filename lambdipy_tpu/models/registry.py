@@ -226,7 +226,7 @@ def _llama_tp_rules():
     ))
 
 
-_ATTN_BACKENDS = ("dense", "flash", "ring")
+_ATTN_BACKENDS = ("dense", "flash", "ring", "blocked")
 _MATMUL_BACKENDS = ("xla", "pallas")
 
 
@@ -272,6 +272,14 @@ def _llama_overrides(extra: dict | None) -> dict:
     fields = set(annotations)
     out = {k: coerce(k, v) for k, v in extra.items()
            if k in fields - {"dtype", "quant"}}
+    # operator-level backend switch: LAMBDIPY_ATTN_BACKEND selects the
+    # attention backend (e.g. "blocked" for length-aware decode reads)
+    # without editing the bundle; an explicit [payload.extra] value wins
+    import os
+
+    env_backend = os.environ.get("LAMBDIPY_ATTN_BACKEND")
+    if env_backend and "attn_backend" not in out:
+        out["attn_backend"] = env_backend
     if out.get("attn_backend", "dense") not in _ATTN_BACKENDS:
         raise ValueError(f"unknown attn_backend {out['attn_backend']!r}; "
                          f"supported: {_ATTN_BACKENDS}")

@@ -1,0 +1,242 @@
+"""Length-aware blocked decode attention: Pallas TPU kernel + pure-jax
+reference.
+
+Decode is memory-bound and the static-cache decode path reads the FULL
+``cache_len`` K/V window every step — a row 300 tokens into an 8k-window
+server streams all 8k positions from HBM per token. This op makes decode
+KV bytes scale with each row's *actual* context instead of its allocated
+window (the mechanism of PagedAttention / Flash-Decoding, specialized to
+the repo's contiguous static cache):
+
+- grid is ``(batch x kv_heads, kv_blocks)`` with the kv dimension
+  innermost — TPU grid execution is sequential, so the online-softmax
+  f32 scratch accumulators (running max / sum / weighted-V) carry across
+  kv steps exactly like ``ops/attention.py``'s ``_flash_kernel``;
+- a per-row ``active_len`` operand rides in scalar-prefetch (SMEM):
+  blocks fully past a row's length SKIP their compute under ``pl.when``,
+  and their K/V BlockSpec index maps CLAMP to the row's last active
+  block — Pallas elides the DMA when consecutive grid steps map to the
+  same block, so the skipped blocks cost neither FLOPs nor HBM bytes.
+  The partially-active boundary block masks per-position;
+- GQA-aware: each program attends ONE kv head against its ``group`` =
+  heads/kv_heads query rows, so grouped K/V is read once per kv head,
+  never re-read per query head;
+- composes with the int8 KV layout (``models/llama.py _kv_quantize``):
+  int8 values + per-position f32 scales stream through the same blocked
+  index maps and dequantize in VMEM right before the dot.
+
+The pure-jax :func:`decode_attention_reference` is the numerics oracle
+and the CPU fallback. Its math mirrors ``models/llama.py _attend``
+operation for operation (same einsums, same f32 ``/ sqrt(d)`` scaling,
+same ``-1e9`` mask fill), so with a float KV cache its output is
+BITWISE the dense decode path's — the parity the blocked backend's
+on/off tests assert. ``decode_attention`` is the dispatcher the model
+layer calls: the kernel on TPU when shapes tile, the reference
+everywhere else (an interpret-mode Pallas call per decode-scan step
+would crawl on CPU; tests exercise the kernel explicitly via
+``interpret=True``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9  # matches models/llama.py _attend's mask fill
+
+
+def decode_attention_reference(q, k, v, active_len, *, scale=None):
+    """Length-masked GQA decode attention, dense-path-bitwise.
+
+    q: [b, s, h, d] (s = 1 for decode steps); k/v: [b, t, kvh, d] float
+    (kv heads grouped, NOT pre-broadcast); active_len: [b] int32 — row r
+    attends positions ``< active_len[r]``. Returns [b, s, h, d].
+
+    The computation is ``models/llama.py _attend`` with the validity
+    mask built from ``active_len``: same grouped einsums, f32 logits
+    divided by ``sqrt(d)``, ``-1e9`` fill, f32 softmax — so on the same
+    inputs the output is bitwise the dense decode path's.
+    """
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    kvh = k.shape[2]
+    group = h // kvh
+    qg = q.reshape(b, s, kvh, group, d)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
+    if scale is None:
+        logits = logits / jnp.sqrt(d).astype(jnp.float32)
+    else:
+        logits = logits * jnp.float32(scale)
+    valid = (jnp.arange(t)[None, :]
+             < jnp.asarray(active_len, jnp.int32)[:, None])  # [b, t]
+    logits = jnp.where(valid[:, None, None, None, :], logits,
+                       jnp.float32(NEG_INF))
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, *, block_k: int, scale: float, quant: bool,
+                   ks_ref=None, vs_ref=None):
+    """One (row, kv-block) grid step. Scratch m/l/acc carry the online
+    softmax across the sequential kv dimension; blocks past the row's
+    active length skip compute entirely (their data was never fetched —
+    the clamped index map re-addressed the previous block)."""
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+    alen = lens_ref[bh]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(ki * block_k < alen)
+    def _compute():
+        q = q_ref[0]  # [group, d]
+        k = k_ref[0]  # [block_k, d]
+        v = v_ref[0]
+        if quant:
+            k = k.astype(jnp.float32) * ks_ref[0].astype(jnp.float32)
+            v = v.astype(jnp.float32) * vs_ref[0].astype(jnp.float32)
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [group, block_k]
+        pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < alen, s, NEG_INF)
+        m_prev = m_ref[...]  # [group, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def blocked_decode_attention(q, k, v, active_len, *, k_scale=None,
+                             v_scale=None, scale=None, block_k: int = 128,
+                             interpret: bool | None = None):
+    """The Pallas blocked decode kernel. q: [b, 1, h, d]; k/v:
+    [b, t, kvh, d] (float, or int8 with ``k_scale``/``v_scale``
+    [b, t, kvh, 1] f32); active_len: [b] int32, PER-ROW >= 1 — a decode
+    step always attends at least its own freshly-written position (the
+    model passes ``index + 1``), and the kernel relies on that: at
+    ``active_len = 0`` no block ever computes, so the finalize would
+    emit exact zeros where the reference emits the uniform-softmax mean
+    of V. Falls back to the reference when shapes don't tile
+    (t % block_k, or a multi-token q). ``interpret=None`` auto-selects
+    interpret mode on the CPU backend."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    kvh = k.shape[2]
+    group = h // kvh
+    quant = k_scale is not None
+    block_k = min(block_k, t)
+    if s != 1 or t % block_k:
+        kd, vd = k, v
+        if quant:
+            kd = k.astype(q.dtype) * k_scale.astype(q.dtype)
+            vd = v.astype(q.dtype) * v_scale.astype(q.dtype)
+        return decode_attention_reference(q, kd, vd, active_len, scale=scale)
+    scale = float(d ** -0.5 if scale is None else scale)
+    nk = t // block_k
+
+    # fold to per-(row, kv-head) programs: q [b*kvh, group, d],
+    # k/v [b*kvh, t, d] — each program reads ONE kv head once for all
+    # its group query heads (the GQA byte win)
+    qf = q.reshape(b, kvh, group, d).reshape(b * kvh, group, d)
+
+    def fold_kv(x, w):
+        return x.transpose(0, 2, 1, 3).reshape(b * kvh, t, w)
+
+    kf, vf = fold_kv(k, d), fold_kv(v, d)
+    lens = jnp.repeat(jnp.asarray(active_len, jnp.int32).reshape(b), kvh)
+
+    def kv_index(bh, ki, lens_ref):
+        # clamp past-the-length blocks to the row's LAST active block:
+        # consecutive identical block indices elide the DMA, so inactive
+        # blocks cost no HBM traffic (their compute is pl.when-skipped)
+        last = jnp.maximum(
+            (lens_ref[bh] + block_k - 1) // block_k - 1, 0)
+        return (bh, jnp.minimum(ki, last), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, group, d), lambda bh, ki, lens: (bh, 0, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    operands = [qf, kf, vf]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, block_k, 1), kv_index),
+            pl.BlockSpec((1, block_k, 1), kv_index),
+        ]
+        operands += [fold_kv(k_scale, 1), fold_kv(v_scale, 1)]
+
+    def kernel(lens_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            ks_ref, vs_ref = None, None
+            o_ref, m_ref, l_ref, acc_ref = rest
+        _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                       acc_ref, block_k=block_k, scale=scale, quant=quant,
+                       ks_ref=ks_ref, vs_ref=vs_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * kvh, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, group, d), lambda bh, ki, lens: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * kvh, group, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(lens, *operands)
+    return out.reshape(b, kvh, group, d).reshape(b, 1, h, d)
+
+
+def decode_attention(q, k, v, active_len, *, k_scale=None, v_scale=None,
+                     scale=None, block_k: int = 128,
+                     interpret: bool | None = None):
+    """Backend dispatcher for the ``attn_backend="blocked"`` decode path.
+
+    On TPU with tileable shapes: the blocked kernel (real early-exit —
+    bytes scale with ``active_len``). Everywhere else: the pure-jax
+    reference, whose output is bitwise the dense path's on float KV —
+    the byte win on the XLA path comes from the runtime's window
+    bucketing instead (``runtime/continuous.py``), which shrinks ``t``
+    itself. Inputs/shapes as :func:`blocked_decode_attention`."""
+    if jax.default_backend() == "tpu" and q.shape[1] == 1 \
+            and k.shape[1] % min(block_k, k.shape[1]) == 0:
+        return blocked_decode_attention(
+            q, k, v, active_len, k_scale=k_scale, v_scale=v_scale,
+            scale=scale, block_k=block_k, interpret=interpret)
+    if k_scale is not None:
+        k = k.astype(q.dtype) * k_scale.astype(q.dtype)
+        v = v.astype(q.dtype) * v_scale.astype(q.dtype)
+    return decode_attention_reference(q, k, v, active_len, scale=scale)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from lambdipy_tpu.parallel.mesh import shard_map_compat
+
 from lambdipy_tpu.parallel.sharding import no_shard_hints
 
 
@@ -67,9 +69,9 @@ def _pipeline_local(params, x, const, *, stage_fn, axis_name: str,
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     def varying(v):
-        have = getattr(jax.typeof(v), "vma", frozenset())
-        need = tuple(a for a in vary_axes if a not in have)
-        return jax.lax.pcast(v, need, to="varying") if need else v
+        from lambdipy_tpu.parallel.mesh import pcast_varying
+
+        return pcast_varying(v, vary_axes)
 
     state0 = varying(jnp.zeros_like(x[0]))
     out0 = varying(jnp.zeros_like(x))
@@ -113,7 +115,7 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh: Mesh, *,
     x_spec = P(None, batch_axes if batch_axes else None)
     params_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     const_specs = jax.tree_util.tree_map(lambda _: P(), const)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         partial(_pipeline_local, stage_fn=stage_fn, axis_name=axis,
                 vary_axes=batch_axes + (axis,)),
         mesh=mesh,

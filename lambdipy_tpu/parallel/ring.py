@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from lambdipy_tpu.parallel.mesh import shard_map_compat
+
 NEG_INF = -1e30
 
 
@@ -64,9 +66,12 @@ def _ring_attention_local(q, k, v, km=None, *, axis_name: str, causal: bool,
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     # mark the initial accumulators as varying over the ring axis so the
-    # scan carry type matches its device-varying outputs (jax vma tracking)
+    # scan carry type matches its device-varying outputs (jax vma
+    # tracking; identity on 0.4.x, which tracks none)
     def varying(x):
-        return jax.lax.pcast(x, vary_axes or (axis_name,), to="varying")
+        from lambdipy_tpu.parallel.mesh import pcast_varying
+
+        return pcast_varying(x, vary_axes or (axis_name,))
 
     m0 = varying(jnp.full((b, h, sq), NEG_INF, jnp.float32))
     l0 = varying(jnp.zeros((b, h, sq), jnp.float32))
@@ -122,9 +127,9 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
                     scale=scale, vary_axes=batch_axes + (axis,))
     if kv_mask is not None:
         mspec = P(batch_axes if batch_axes else None, axis)
-        fn = jax.shard_map(local, mesh=mesh,
+        fn = shard_map_compat(local, mesh=mesh,
                            in_specs=(spec, spec, spec, mspec), out_specs=spec)
         return fn(q, k, v, kv_mask)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map_compat(local, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(q, k, v)

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from lambdipy_tpu.parallel.mesh import shard_map_compat
+
 NEG_INF = -1e30
 
 
@@ -143,7 +145,7 @@ def sp_decode_step(q, store_new: dict, cache: dict, index, mesh: Mesh,
     quant = "k_int8" in cache
     local = partial(_sp_decode_local, axis_name=axis, scale=scale,
                     quant=quant)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local, mesh=mesh,
         in_specs=(rep, {name: rep for name in store_new},
                   {name: cspec for name in cache}, ispec),

@@ -129,6 +129,7 @@ class AotStore:
         at a torn tier file.
         """
         import jax
+        import jax.export  # 0.4.x: submodule is not auto-imported
 
         self.dir.mkdir(parents=True, exist_ok=True)
         paths = self._paths(name)
@@ -188,6 +189,7 @@ class AotStore:
         when the caller knows only the executable tier can win.
         """
         import jax
+        import jax.export  # 0.4.x: submodule is not auto-imported
 
         self.dir.mkdir(parents=True, exist_ok=True)
         paths = self._paths(name)
@@ -343,6 +345,7 @@ class AotStore:
     def _load_tier(self, tier: str, paths: dict):
         """Deserialize one tier into a callable (no probing/gating)."""
         import jax
+        import jax.export  # 0.4.x: submodule is not auto-imported
 
         if tier == "exec" and paths["exec"].is_file():
             from jax.experimental import serialize_executable

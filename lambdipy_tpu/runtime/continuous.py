@@ -65,13 +65,25 @@ class ContinuousBatcher:
 
     def __init__(self, server: Any, *, slots: int = 8, segment: int = 16,
                  cache_len: int | None = None,
-                 group_prefill_max: int = 256, policy: Any = None):
+                 group_prefill_max: int = 256, policy: Any = None,
+                 window_bucketing: bool = True):
         import jax
+
+        from lambdipy_tpu.runtime.metrics import DecodeWindowStats
 
         self.server = server
         cfg = server.model.cfg
         self.slots = max(1, slots)
         self.segment = max(1, segment)
+        # length-aware decode dispatch: each segment runs through a pow-2
+        # WINDOW-bucketed program variant sized to the live batch's max
+        # active context (LlamaServer._windowed_seg_fn), so XLA decode
+        # KV reads scale with what rows actually hold instead of the
+        # full engine cache — the decode-side twin of prefill
+        # bucketing. Tokens are bitwise the full-window program's; the
+        # plain segment program still serves windows at the cache cap.
+        self.window_bucketing = bool(window_bucketing)
+        self.window_stats = DecodeWindowStats()
         # sched policy: when slots are scarce, waiting joiners are packed
         # in POLICY order (priority / fair-share by request class from
         # the scheduler's context) instead of arrival order; None = FIFO
@@ -325,7 +337,9 @@ class ContinuousBatcher:
         import numpy as np
 
         server = self.server
-        seg = self._segment_fn()
+        from lambdipy_tpu.models.llama import _next_bucket
+
+        seg_full = self._segment_fn()
         # eos stays disabled on device (host-side truncation); the
         # sampling knobs are PER-SLOT vectors rebuilt before each
         # segment from the active rows' own requests
@@ -391,17 +405,43 @@ class ContinuousBatcher:
                 t_host = np.zeros((self.slots,), np.float32)
                 k_host = np.zeros((self.slots,), np.int32)
                 p_host = np.ones((self.slots,), np.float32)
+                positions = []  # active rows' pre-segment decode positions
                 for slot, e in enumerate(self._active):
                     if e is not None:
                         t_host[slot] = e["temperature"] or 0.0
                         k_host[slot] = e["top_k"] or 0
                         p_host[slot] = (1.0 if e["top_p"] is None
                                         else e["top_p"])
+                        positions.append(e["pos0"] + len(e["toks"]))
+            # window bucketing: the segment's furthest write lands at
+            # max(pos) + segment - 1, so a pow-2 window >= max(pos) +
+            # segment keeps every active row's reads/writes in bounds
+            # and the output bitwise the full-window program's. Retired
+            # slots' garbage rows may hold larger stale positions; their
+            # out-of-window scatters drop harmlessly (nothing reads them).
+            window = self.cache_len
+            if self.window_bucketing and positions:
+                needed = max(positions) + self.segment
+                window = min(_next_bucket(needed, 16), self.cache_len)
+            if window < self.cache_len:
+                seg = server._windowed_seg_fn(self.slots, self.cache_len,
+                                              window, self.segment)
+            else:
+                seg = seg_full
             with server._mesh_ctx():
                 (toks, lps), self._carry = seg(
                     server.params, jnp.asarray(t_host),
                     jnp.asarray(k_host), jnp.asarray(p_host),
                     *self._carry, eos_op)
+            # attended = per-row sum of positions each step's attention
+            # actually covered (pos + 1 keys at write index pos)
+            self.window_stats.record_segment(
+                attended=sum(self.segment * p
+                             + self.segment * (self.segment + 1) // 2
+                             for p in positions),
+                window_read=len(positions) * self.segment * window,
+                full_window=len(positions) * self.segment * self.cache_len,
+                window=window)
             # one host fetch per segment: on a remote-tunnel transport
             # every device_get of a fresh result pays one RTT (~66 ms
             # measured), so the logprob block rides the same fetch — and
@@ -478,6 +518,10 @@ class ContinuousBatcher:
                  "seed": seed, "toks": [], "lps": [],
                  "want_lp": return_logprobs,
                  "done": False, "error": None, "slot": None, "packed": False,
+                 # decode position at join time (prompt end; prefix rows
+                 # include the cached prefix) — the window bucketing's
+                 # host-side view of how far this row's cache reaches
+                 "pos0": s,
                  "cls": current_request_class(), "seq": next(_entry_seq)}
         if prefix is not None:
             # a prefix carry can only pack into an engine whose slots
@@ -492,6 +536,7 @@ class ContinuousBatcher:
             pentry = self.server._prefix_entry(prefix)
             if self.cache_len != cache_width(pentry[0]):
                 return None
+            entry["pos0"] = pentry[1] + s
             entry["carry"] = self._prefill_prefix_row(prefix, row, s,
                                                       entry, pentry)
             with self._lock:
@@ -635,6 +680,8 @@ class ContinuousBatcher:
             active = sum(1 for a in self._active if a is not None)
             return {"mode": "continuous", "slots": self.slots,
                     "segment": self.segment, "cache_len": self.cache_len,
+                    "window_bucketing": self.window_bucketing,
+                    "decode_window": self.window_stats.report(),
                     "segments_run": self.segments_run,
                     "rows_in_segments": self.rows_in_segments,
                     "requests_served": self.requests_served,

@@ -396,11 +396,25 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # window caches otherwise — at 8B dims that is HBM that the
             # operator must be able to cap per bundle)
             bcl = extra.get("batch_cache_len")
+            # length-aware window bucketing (on by default): pow-2
+            # window program variants are compiled AT FIRST USE per
+            # bucket (deliberately un-AOT-able), so a latency-critical
+            # bundle on a slow-compile transport can opt out via
+            # `batch_window_bucketing = "0"` (or the
+            # LAMBDIPY_WINDOW_BUCKETING env default) and keep the
+            # single AOT-warmed full-window segment program. Same
+            # precedence as LAMBDIPY_ATTN_BACKEND: an explicit bundle
+            # extra wins over the environment.
+            wb = extra.get(
+                "batch_window_bucketing",
+                _os.environ.get("LAMBDIPY_WINDOW_BUCKETING", "1"))
             batcher = continuous = ContinuousBatcher(
                 server, slots=int(extra.get("batch_max", 8)),
                 segment=int(extra.get("batch_segment", 16)),
                 cache_len=int(bcl) if bcl else None,
-                policy=sched_policy)
+                policy=sched_policy,
+                window_bucketing=str(wb).lower() not in ("0", "false",
+                                                         "off"))
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 

@@ -68,6 +68,49 @@ class LatencyStats:
 
 
 @dataclass
+class DecodeWindowStats:
+    """Counters for length-aware decode (the ``decode.window`` block on
+    ``/metrics``): how many KV positions each decode step actually
+    ATTENDED vs how many the dispatched program READ vs what the full
+    static window would have read. ``savings_ratio`` = read / full —
+    < 1 means the window bucketing (or the blocked kernel) cut decode
+    KV traffic; 1.0 means every step paid the whole allocated window.
+    ``buckets`` histograms the pow-2 windows segments dispatched at."""
+
+    attended_tokens: int = 0   # sum over rows x steps of positions attended
+    window_tokens: int = 0     # sum of positions the program actually read
+    full_tokens: int = 0       # what the full static window would have read
+    segments: int = 0
+    buckets: dict = field(default_factory=dict)  # window -> segment count
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_segment(self, *, attended: int, window_read: int,
+                       full_window: int, window: int) -> None:
+        with self._lock:
+            self.attended_tokens += int(attended)
+            self.window_tokens += int(window_read)
+            self.full_tokens += int(full_window)
+            self.segments += 1
+            self.buckets[int(window)] = self.buckets.get(int(window), 0) + 1
+
+    def report(self) -> dict:
+        with self._lock:
+            full = self.full_tokens
+            return {
+                "attended_tokens": self.attended_tokens,
+                "window_tokens": self.window_tokens,
+                "full_tokens": full,
+                "savings_ratio": (round(self.window_tokens / full, 4)
+                                  if full else 1.0),
+                "attended_ratio": (round(self.attended_tokens / full, 4)
+                                   if full else 1.0),
+                "segments": self.segments,
+                "buckets": {str(w): n
+                            for w, n in sorted(self.buckets.items())},
+            }
+
+
+@dataclass
 class PrefixCacheStats:
     """Counters for the automatic cross-request prefix KV cache: a
     request whose prompt longest-prefix-matches the radix tree is a hit

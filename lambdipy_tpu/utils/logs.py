@@ -29,10 +29,29 @@ class _JsonFormatter(logging.Formatter):
         return json.dumps(payload, default=str)
 
 
+class _LiveStderrHandler(logging.StreamHandler):
+    """StreamHandler that resolves ``sys.stderr`` at EMIT time. A handler
+    that binds the stream once breaks under test runners (click's
+    CliRunner) that swap and then CLOSE sys.stderr per invocation: every
+    later log line becomes a '--- Logging error ---' traceback spewed
+    into whatever stream is current — polluting captured CLI output."""
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):  # StreamHandler.setStream/init compat
+        pass
+
+
 def get_logger(name: str) -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers:
-        handler = logging.StreamHandler(sys.stderr)
+        handler = _LiveStderrHandler()
         if os.environ.get("LAMBDIPY_LOG_FORMAT", "json") == "json":
             handler.setFormatter(_JsonFormatter())
         else:

@@ -116,6 +116,26 @@ def llama_decode_step_cost(cfg, *, batch: int, cache_len: int,
     return Cost(float(flops), float(hbm))
 
 
+def llama_decode_window_cost(cfg, *, batch: int, window_len: int,
+                             active_len: int | None = None,
+                             weight_bytes: int | None = None) -> Cost:
+    """Cost of ONE decode step under length-aware blocked/bucketed
+    attention: the program READS ``window_len`` KV positions per row
+    (the pow-2 window bucket, or the blocked kernel's fetched blocks)
+    while attention FLOPs cover ``active_len`` positions actually
+    attended (defaults to the window). The decode-window savings story
+    is this against :func:`llama_decode_step_cost` at the full static
+    ``cache_len`` — short rows stop paying full-window KV reads."""
+    # one formula: delegate to the dense step cost at the READ window,
+    # then deduct the attention FLOPs of the positions never attended
+    base = llama_decode_step_cost(cfg, batch=batch, cache_len=window_len,
+                                  weight_bytes=weight_bytes)
+    active = window_len if active_len is None else active_len
+    flops = base.flops - batch * cfg.layers * 4 * cfg.hidden * (
+        window_len - active)
+    return Cost(float(flops), base.hbm_bytes)
+
+
 def llama_decode_tok_s_bound(cfg, *, batch: int, cache_len: int) -> float:
     """Roofline upper bound on decode tokens/second at this batch."""
     c = llama_decode_step_cost(cfg, batch=batch, cache_len=cache_len)

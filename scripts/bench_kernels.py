@@ -100,6 +100,51 @@ def bench_attention(rtt: float):
         print(json.dumps(out))
 
 
+def bench_decode_attention(rtt: float):
+    """Length-aware blocked decode attention vs the full-window dense
+    reference at 8B decode shapes: [8, 1, 32 h, 128 d] queries against
+    an 8192-position KV window (GQA kv=8, bf16), at active lengths
+    512 / 2048 / 8192. The claim under test: blocked KV bytes scale
+    with ``active_len`` (early-exit blocks skip compute AND their DMA
+    via the clamped index map), so short rows stop paying full-window
+    reads. ``kv_gb_s`` is bytes-the-path-must-read / time — for dense
+    that is always the full window, for blocked the active prefix."""
+    import jax
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.ops.decode_attention import (
+        blocked_decode_attention, decode_attention_reference)
+
+    b, h, kvh, d, t = 8, 32, 8, 128, 8192
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, 1, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, t, kvh, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, t, kvh, d), jnp.bfloat16)
+    iters = 50
+    for alen in (512, 2048, 8192):
+        lens = jnp.full((b,), alen, jnp.int32)
+        dense = _scan_many(
+            lambda c: decode_attention_reference(c, k, v, lens), iters)
+        blocked = _scan_many(
+            lambda c: blocked_decode_attention(c, k, v, lens,
+                                               interpret=False), iters)
+        out = {"op": "decode_attention", "active_len": alen, "window": t,
+               "batch": b, "heads": h, "kv_heads": kvh, "dim": d,
+               "iters": iters}
+        full_bytes = b * t * 2 * kvh * d * 2      # k+v, bf16, full window
+        act_bytes = b * alen * 2 * kvh * d * 2    # what blocked must read
+        for name, fn, nbytes in (("dense_ms", dense, full_bytes),
+                                 ("blocked_ms", blocked, act_bytes)):
+            ms = _amortized_ms(lambda: fn(q), rtt, iters)
+            out[name] = round(ms, 3)
+            out[name.replace("_ms", "_kv_gb_s")] = round(
+                nbytes / (ms / 1e3) / 1e9, 1)
+        out["winner"] = ("blocked" if out["blocked_ms"] < out["dense_ms"]
+                         else "dense")
+        print(json.dumps(out))
+
+
 def bench_int8_matmul(rtt: float):
     import jax
     import jax.numpy as jnp
@@ -149,6 +194,7 @@ def main() -> int:
     print(json.dumps({"platform": devices[0].platform,
                       "rtt_ms": round(rtt, 2)}))
     bench_attention(rtt)
+    bench_decode_attention(rtt)
     bench_int8_matmul(rtt)
     return 0
 

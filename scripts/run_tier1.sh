@@ -40,4 +40,15 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     echo "FATAL: bench.py CPU smoke failed" >&2
     exit 1
 fi
+
+# Phase 4: decode-window sweep smoke (CPU reference path) — asserts
+# token parity between windowed and full-window decode AND that the
+# KV-read savings_ratio is < 1 for short rows and monotone in prompt
+# length, so a length-aware-decode regression turns tier-1 red.
+echo "== phase 4: decode-window bench smoke =="
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --decode-window; then
+    echo "FATAL: bench.py --decode-window smoke failed" >&2
+    exit 1
+fi
 exit 0

@@ -82,6 +82,7 @@ def test_deploy_rejects_unknown_target(tmp_path):
     assert "neither a bundle dir" in r.output
 
 
+@pytest.mark.slow  # >14 s; sibling tests keep this surface in tier-1 (wall budget)
 def test_build_records_warm_outcome_in_manifest(tiny_recipe_dir, tmp_path,
                                                 monkeypatch):
     """The warm step's outcome is part of the bundle record (VERDICT r2

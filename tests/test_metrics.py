@@ -1,10 +1,12 @@
 """LatencyStats: percentile edge cases + reservoir wraparound (the seed
 overwrote with the post-increment count, skewing the ring by one and
-making slot 0 immortal). Plus the prefix-cache counter block."""
+making slot 0 immortal). Plus the prefix-cache counter block and the
+decode-window (length-aware decode) counter block."""
 
 import threading
 
-from lambdipy_tpu.runtime.metrics import LatencyStats, PrefixCacheStats
+from lambdipy_tpu.runtime.metrics import (DecodeWindowStats, LatencyStats,
+                                          PrefixCacheStats)
 
 
 def test_empty_reservoir_reports_none():
@@ -83,6 +85,51 @@ def test_report_under_concurrent_recording():
             t.join()
     final = stats.report()
     assert final["count"] > 0 and final["errors"] > 0
+
+
+def test_decode_window_stats_counters():
+    """The ``decode.window`` block the continuous engine publishes:
+    attended / read / full token accounting, the savings ratio (< 1
+    means windowed decode cut KV traffic), the pow-2 bucket histogram,
+    and safe empty-state reporting."""
+    st = DecodeWindowStats()
+    assert st.report() == {"attended_tokens": 0, "window_tokens": 0,
+                           "full_tokens": 0, "savings_ratio": 1.0,
+                           "attended_ratio": 1.0, "segments": 0,
+                           "buckets": {}}
+    # 2 rows x 4 steps at a 64-window inside a 256 cache
+    st.record_segment(attended=300, window_read=2 * 4 * 64,
+                      full_window=2 * 4 * 256, window=64)
+    # 1 row x 4 steps at the full window
+    st.record_segment(attended=900, window_read=4 * 256,
+                      full_window=4 * 256, window=256)
+    rep = st.report()
+    assert rep["segments"] == 2
+    assert rep["attended_tokens"] == 1200
+    assert rep["window_tokens"] == 512 + 1024
+    assert rep["full_tokens"] == 2048 + 1024
+    assert rep["savings_ratio"] == round(1536 / 3072, 4)
+    assert rep["attended_ratio"] == round(1200 / 3072, 4)
+    assert rep["buckets"] == {"64": 1, "256": 1}
+
+
+def test_decode_window_stats_concurrent():
+    st = DecodeWindowStats()
+
+    def write():
+        for _ in range(200):
+            st.record_segment(attended=10, window_read=32, full_window=64,
+                              window=32)
+
+    threads = [threading.Thread(target=write) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = st.report()
+    assert rep["segments"] == 800
+    assert rep["window_tokens"] == 800 * 32
+    assert rep["savings_ratio"] == 0.5
 
 
 def test_prefix_cache_stats_counters():

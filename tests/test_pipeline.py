@@ -115,6 +115,7 @@ def test_pipeline_requires_pp_axis(cpu_devices):
                        split_microbatches(jnp.zeros((4, 4)), 2), mesh)
 
 
+@pytest.mark.slow  # heavyweight composition parity (tier-1 wall budget); fast siblings cover the mechanism
 def test_llama_pipeline_forward_matches(cpu_devices):
     """llama-tiny blocks pipelined over pp=2 reproduce the plain forward."""
     from lambdipy_tpu.models import registry
@@ -134,6 +135,7 @@ def test_llama_pipeline_forward_matches(cpu_devices):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # heavyweight composition parity (tier-1 wall budget); fast siblings cover the mechanism
 def test_llama_pipeline_forward_composes_with_dp(cpu_devices):
     """pp=2 × dp=2: replicated const broadcasts against dp-local batches."""
     from lambdipy_tpu.models import registry

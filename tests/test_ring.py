@@ -40,6 +40,7 @@ def test_ring_attention_gqa(cpu_devices):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # heavyweight composition parity (tier-1 wall budget); fast siblings cover the mechanism
 def test_ring_attention_composes_with_dp(cpu_devices):
     b, s, h, d = 4, 16, 2, 8
     q, k, v = (_rand((b, s, h, d), i) for i in range(3))
@@ -252,6 +253,7 @@ def test_sp_decode_strongly_negative_logits_with_empty_shards(cpu_devices):
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow  # heavyweight composition parity (tier-1 wall budget); fast siblings cover the mechanism
 def test_sp_decode_int8_kv_matches_replicated_int8(cpu_devices, count_sp_decode):
     """kv_quant='int8' composes with sp decode: the int8 cache leaves
     shard over sp, the sp path traces, and serve outputs match the

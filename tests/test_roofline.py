@@ -66,6 +66,25 @@ def test_kv_quant_halves_cache_traffic():
     assert R.llama_kv_bytes_per_pos(q) * 2 == R.llama_kv_bytes_per_pos(cfg)
 
 
+def test_decode_window_cost_scales_with_active_length():
+    """The length-aware decode cost model: a short active window reads
+    (and attends) less than the full static window, converging to the
+    dense step cost when window == cache_len."""
+    cfg = dataclasses.replace(LLAMA3_8B, quant="int8")
+    full = R.llama_decode_step_cost(cfg, batch=1, cache_len=8192)
+    short = R.llama_decode_window_cost(cfg, batch=1, window_len=512,
+                                       active_len=300)
+    assert short.hbm_bytes < full.hbm_bytes
+    assert short.flops < full.flops
+    # KV bytes scale with the window actually read
+    kv_full = full.hbm_bytes - R.llama_weight_bytes(cfg)
+    kv_short = short.hbm_bytes - R.llama_weight_bytes(cfg)
+    assert kv_short == pytest.approx(kv_full * 512 / 8192)
+    # window == cache_len degenerates to the dense step cost exactly
+    same = R.llama_decode_window_cost(cfg, batch=1, window_len=8192)
+    assert (same.flops, same.hbm_bytes) == (full.flops, full.hbm_bytes)
+
+
 def test_prefill_is_compute_bound_at_1k():
     cfg = dataclasses.replace(LLAMA3_8B, quant="int8")
     c = R.llama_prefill_cost(cfg, batch=1, seq_len=1024)

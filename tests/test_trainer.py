@@ -37,6 +37,7 @@ def test_trainer_learns_and_logs(cpu_devices):
     assert report.history[-1]["loss"] < report.history[0]["loss"]
 
 
+@pytest.mark.slow  # >14 s; sibling tests keep this surface in tier-1 (wall budget)
 def test_trainer_resume_continues_exactly(cpu_devices, tmp_path):
     import jax
 
