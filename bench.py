@@ -269,6 +269,16 @@ def _stage_decode8b() -> int:
     return 0
 
 
+def _shared_prefix_rows(rng, *, n_requests: int, prefix_len: int,
+                        suffix_len: int, vocab: int) -> list:
+    """The --shared-prefix workload generator: ``n_requests`` prompts
+    sharing one random ``prefix_len``-token prefix, each with a distinct
+    random suffix. Also the --fleet workload's per-group generator."""
+    shared = rng.integers(1, vocab, prefix_len).tolist()
+    return [shared + rng.integers(1, vocab, suffix_len).tolist()
+            for _ in range(n_requests)]
+
+
 def shared_prefix_record(*, n_requests: int = 8, prefix_len: int = 512,
                          suffix_len: int = 16, n_new: int = 16,
                          block: int = 64, extra: dict | None = None) -> dict:
@@ -300,9 +310,10 @@ def shared_prefix_record(*, n_requests: int = 8, prefix_len: int = 512,
     params = jax.device_put(adapter.init_params(seed=0))
 
     rng = np.random.default_rng(0)
-    shared = rng.integers(1, cfg.vocab_size, prefix_len).tolist()
-    rows = [shared + rng.integers(1, cfg.vocab_size, suffix_len).tolist()
-            for _ in range(n_requests)]
+    rows = _shared_prefix_rows(rng, n_requests=n_requests,
+                               prefix_len=prefix_len,
+                               suffix_len=suffix_len,
+                               vocab=cfg.vocab_size)
     # warm traffic: same shapes, disjoint tokens — compiles every program
     # both paths need without seeding the store with the workload prefix
     warm_row = rng.integers(1, cfg.vocab_size,
@@ -390,6 +401,131 @@ def shared_prefix_record(*, n_requests: int = 8, prefix_len: int = 512,
         "prefill_flops_on": flops_on,
         "prefill_flop_ratio": round(flops_off / flops_on, 2),
         "prefix_cache": store.stats(),
+    }
+
+
+def fleet_record(*, replicas: int = 2, requests_per_group: int = 6,
+                 groups: int = 2, prefix_len: int = 64, suffix_len: int = 8,
+                 n_new: int = 8, block: int = 16) -> dict:
+    """Fleet serving sweep (CPU-runnable): ``replicas`` in-process bundle
+    servers behind the prefix-affinity router vs ONE replica hit
+    directly, on a shared-prefix workload (``groups`` distinct shared
+    prefixes via the --shared-prefix generator). Asserts BITWISE output
+    parity between the router-fronted and direct responses (greedy, so
+    platform-free), and reports throughput for both plus the router's
+    affinity hit rate and the fleet-aggregate prefix-cache hit rate —
+    the claim being measured is that affinity routing keeps the radix
+    cache concentrated instead of diluted 1/N."""
+    import tempfile
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+    from pathlib import Path
+
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.bundle import assemble_bundle
+    from lambdipy_tpu.fleet import FleetRouter, ReplicaPool
+    from lambdipy_tpu.recipes.schema import load_recipe_dict
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    tmp = Path(tempfile.mkdtemp(prefix="lambdipy-fleet-bench-"))
+    doc = {
+        "schema": 1, "name": "fleet-bench", "version": "0.1",
+        "device": "any", "base_layer": "jax-tpu", "requires": [],
+        "payload": {
+            "model": "llama-tiny",
+            "handler": "lambdipy_tpu.runtime.handlers:generate_handler",
+            "params": "init", "dtype": "float32",
+            "extra": {"max_new_tokens": str(n_new), "serve_aot": "0",
+                      "warm_group_prefill": "0",
+                      "prefix_cache_mb": "64",
+                      "prefix_block": str(block)},
+        },
+    }
+    result = build_recipe(load_recipe_dict(doc), tmp / "work",
+                          run_smoke=False)
+    bundle = tmp / "bundle"
+    assemble_bundle(result, bundle, with_payload=True)
+
+    rng = np.random.default_rng(0)
+    rows = [row for _ in range(groups)
+            for row in _shared_prefix_rows(rng,
+                                           n_requests=requests_per_group,
+                                           prefix_len=prefix_len,
+                                           suffix_len=suffix_len,
+                                           vocab=512)]
+
+    def post(url: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return json.loads(resp.read())
+
+    def completion(base: str, row: list) -> list:
+        out = post(f"{base}/v1/completions",
+                   {"prompt": row, "max_tokens": n_new, "temperature": 0})
+        return out["choices"][0]["tokens"]
+
+    # -- direct: one replica, no router --------------------------------------
+    direct = BundleServer(bundle, warmup=False).start_background()
+    base = f"http://127.0.0.1:{direct.port}"
+    completion(base, rows[0])  # compile warm, off the clock
+    t0 = time.monotonic()
+    direct_out = [completion(base, row) for row in rows]
+    direct_s = time.monotonic() - t0
+    direct.stop()
+
+    # -- fleet: N replicas behind the affinity router ------------------------
+    servers = [BundleServer(bundle, warmup=False).start_background()
+               for _ in range(replicas)]
+    pool = ReplicaPool(probe_interval=0.5)
+    for i, s in enumerate(servers):
+        pool.attach(f"r{i}", f"http://127.0.0.1:{s.port}")
+    pool.probe_all()
+    pool.start()
+    router = FleetRouter(pool, affinity_on=True,
+                         block=block).start_background()
+    rbase = f"http://127.0.0.1:{router.port}"
+    try:
+        completion(rbase, rows[0])  # compile warm on the affinity target
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            fleet_out = list(ex.map(lambda row: completion(rbase, row),
+                                    rows))
+        fleet_s = time.monotonic() - t0
+        if any(a != b for a, b in zip(direct_out, fleet_out)):
+            raise AssertionError(
+                "fleet parity broke: router-fronted tokens != direct "
+                "single-replica tokens")
+        with urllib.request.urlopen(f"{rbase}/metrics", timeout=30) as resp:
+            metrics = json.loads(resp.read())
+    finally:
+        router.stop()
+        pool.close()
+        for s in servers:
+            s.stop()
+    total_new = len(rows) * n_new
+    return {
+        "mode": "fleet",
+        "platform": jax.devices()[0].platform,
+        "replicas": replicas,
+        "n_requests": len(rows),
+        "groups": groups,
+        "prefix_len": prefix_len,
+        "suffix_len": suffix_len,
+        "block": block,
+        "parity": True,
+        "direct_tok_s": round(total_new / direct_s, 1),
+        "fleet_tok_s": round(total_new / fleet_s, 1),
+        "affinity_hit_rate":
+            metrics["router"]["affinity"]["hit_rate"],
+        "fleet_prefix_cache": metrics["fleet"]["prefix_cache"],
+        "routed": {name: rep["routed"]
+                   for name, rep in metrics["pool"].items()},
     }
 
 
@@ -502,6 +638,27 @@ def _decode_window_main() -> int:
     return 0
 
 
+def _fleet_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fleet", action="store_true")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests-per-group", type=int, default=6)
+    ap.add_argument("--groups", type=int, default=2)
+    ap.add_argument("--prefix-len", type=int, default=64)
+    ap.add_argument("--suffix-len", type=int, default=8)
+    ap.add_argument("--n-new", type=int, default=8)
+    ap.add_argument("--block", type=int, default=16)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(fleet_record(
+        replicas=args.replicas, requests_per_group=args.requests_per_group,
+        groups=args.groups, prefix_len=args.prefix_len,
+        suffix_len=args.suffix_len, n_new=args.n_new, block=args.block)))
+    return 0
+
+
 def _shared_prefix_main() -> int:
     import argparse
 
@@ -597,6 +754,10 @@ def main() -> int:
         # CPU-runnable decode-window sweep: parity + monotone KV-read
         # savings from the length-aware windowed decode path
         return _decode_window_main()
+    if "--fleet" in sys.argv:
+        # CPU-runnable fleet sweep: N replicas behind the affinity
+        # router vs one direct — parity + affinity/prefix hit rates
+        return _fleet_main()
     if "--stage" in sys.argv:
         stage = sys.argv[sys.argv.index("--stage") + 1]
         return {"devices": _stage_devices, "matmul": _stage_matmul,

@@ -486,6 +486,109 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
     server.serve_forever()
 
 
+@main.command("fleet")
+@click.argument("bundle")
+@click.option("--replicas", "-n", type=int, default=2, show_default=True,
+              help="supervised bundle-server replicas to run")
+@click.option("--port", type=int, default=8080, show_default=True,
+              help="router port (replicas pick their own free ports)")
+@click.option("--name", default=None,
+              help="fleet name; replicas deploy as NAME-r0..N-1")
+@click.option("--registry", "registry_dir", type=click.Path(), default=None)
+@click.option("--affinity/--no-affinity", default=True, show_default=True,
+              help="route by consistent hash of the prompt's leading "
+                   "token blocks so shared prefixes reuse one replica's "
+                   "radix KV cache")
+@click.option("--block", type=int, default=32, show_default=True,
+              help="affinity block width in tokens — keep equal to the "
+                   "bundle's prefix_block")
+@click.option("--probe-interval", type=float, default=1.0, show_default=True,
+              help="seconds between /healthz probes per replica")
+@click.option("--fail-threshold", type=int, default=1, show_default=True,
+              help="consecutive probe/connect failures before ejection")
+@click.option("--readmit-passes", type=int, default=2, show_default=True,
+              help="consecutive probe passes before an ejected replica "
+                   "takes traffic again")
+@click.option("--retries", type=int, default=2, show_default=True,
+              help="max re-sends of a request onto different replicas")
+@click.option("--saturation", type=int, default=8, show_default=True,
+              help="outstanding requests at which the affinity target is "
+                   "bypassed for the least-loaded replica")
+@click.option("--hedge", default="off", show_default=True,
+              help="duplicate slow non-streamed requests on a second "
+                   "replica: 'off', 'p95' (the router's observed P95), "
+                   "or a fixed threshold in ms")
+@click.option("--timeout", type=float, default=300.0, show_default=True,
+              help="per-replica deploy ready timeout (seconds)")
+def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
+              probe_interval, fail_threshold, readmit_passes, retries,
+              saturation, hedge, timeout):
+    """Serve a bundle from N supervised replicas behind one router.
+
+    Spawns REPLICAS watchdogged deployments of BUNDLE, health-probes
+    them (eject on failure, re-admit on recovery), and serves
+    /v1/completions + /invoke on PORT with prefix-affinity routing,
+    failover retries, and fleet-wide /metrics."""
+    import signal as _signal
+    import threading as _threading
+
+    from lambdipy_tpu.fleet import FleetRouter, ReplicaPool
+    from lambdipy_tpu.runtime.deploy import LocalRuntime
+
+    if replicas < 1:
+        raise click.ClickException("--replicas must be >= 1")
+    hedge_ms: float | str = 0
+    if hedge not in ("off", "0", ""):
+        if hedge == "p95":
+            hedge_ms = "p95"
+        else:
+            try:
+                hedge_ms = float(hedge)
+            except ValueError:
+                raise click.ClickException(
+                    f"--hedge must be 'off', 'p95' or a threshold in "
+                    f"ms, got {hedge!r}")
+    bundle_dir = _resolve_bundle(bundle, registry_dir)
+    fleet_name = name or bundle.split("/")[-1]
+    pool = ReplicaPool(probe_interval=probe_interval,
+                       fail_threshold=fail_threshold,
+                       readmit_passes=readmit_passes)
+    spawned = []
+    try:
+        spawned = pool.spawn_fleet(bundle_dir, replicas,
+                                   base_name=fleet_name,
+                                   runtime=LocalRuntime(),
+                                   ready_timeout=timeout)
+        pool.start()
+        # inside the same guard: a router bind failure (port in use)
+        # must not leak N supervised replica processes
+        router = FleetRouter(pool, port=port, affinity_on=affinity,
+                             block=block, max_retries=retries,
+                             saturation=saturation, hedge_ms=hedge_ms)
+    except BaseException:
+        # a half-spawned fleet must not leak processes — including on
+        # Ctrl-C, which lands mid-boot more often than anywhere else
+        # (each replica's cold start can take minutes)
+        pool.stop_all()
+        raise
+    click.echo(json.dumps({
+        "ready": True, "port": router.port, "replicas": len(spawned),
+        "affinity": affinity, "block": block,
+        "urls": {r.name: r.url for r in spawned},
+    }))
+
+    def _term(signum, frame):
+        _threading.Thread(target=router.stop, daemon=True).start()
+
+    _signal.signal(_signal.SIGTERM, _term)
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        pool.stop_all()
+
+
 @main.command("invoke")
 @click.argument("name")
 @click.option("--data", default="{}", help="JSON request body")

@@ -1,0 +1,34 @@
+"""Replica fleet: prefix-affinity router + health-driven replica pool.
+
+The front door that multiplies the per-replica serve stack across N
+supervised bundle servers — see pool.py (spawn/probe/eject/readmit/
+rolling drain), affinity.py (rendezvous hashing over leading token
+blocks, matching the radix prefix cache), and router.py (the HTTP
+front-door with retry/hedge/metrics-aggregation).
+"""
+
+from lambdipy_tpu.fleet.affinity import DEFAULT_BLOCK, pick_replica, prefix_key
+from lambdipy_tpu.fleet.pool import (
+    DRAINING,
+    EJECTED,
+    READY,
+    STOPPED,
+    FleetError,
+    Replica,
+    ReplicaPool,
+)
+from lambdipy_tpu.fleet.router import FleetRouter
+
+__all__ = [
+    "DEFAULT_BLOCK",
+    "DRAINING",
+    "EJECTED",
+    "READY",
+    "STOPPED",
+    "FleetError",
+    "FleetRouter",
+    "Replica",
+    "ReplicaPool",
+    "pick_replica",
+    "prefix_key",
+]

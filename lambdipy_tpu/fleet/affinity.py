@@ -1,0 +1,116 @@
+"""Prefix-affinity keys and replica selection for the fleet router.
+
+The radix prefix cache (runtime/prefixstore.py) is per-replica: spraying
+shared-prefix traffic round-robin across N replicas dilutes every
+replica's hit rate to ~1/N of what one process would see. The router
+instead hashes each request's LEADING TOKEN BLOCKS — the same fixed
+block width the radix tree is keyed by — so all prompts that would
+longest-prefix-match each other land on the replica that already holds
+their KV.
+
+Two pieces:
+
+- :func:`prefix_key` turns a request body (internal ``/invoke`` shape or
+  OpenAI ``/v1/completions`` shape) into a stable bytes key over the
+  prompt's leading whole blocks. Prompts shorter than one block key on
+  the whole prompt (the radix store cannot cache them, but identical
+  short prompts still co-locate); string prompts key on a leading
+  character window sized ~4 chars/token so tokenizer-equal prefixes
+  agree without tokenizing in the router.
+- :func:`pick_replica` is RENDEZVOUS (highest-random-weight) hashing:
+  each (key, replica) pair scores independently, so ejecting or draining
+  one replica remaps ONLY the keys that were on it — the rest of the
+  fleet keeps its warm caches. A plain modulo ring would reshuffle
+  nearly every key on any membership change.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+# keep in sync with runtime/prefixstore.py PrefixStore's default block;
+# the router's --block flag overrides it to match non-default bundles
+DEFAULT_BLOCK = 32
+
+# the key window: only the FIRST key_blocks whole blocks feed the hash.
+# Keying on every whole block would give prompts that share a long
+# prefix but differ in later blocks (512-token system prompt + distinct
+# 100-token user turns) different keys — scattering exactly the traffic
+# affinity exists to co-locate. Eight 32-token blocks ≈ a system-prompt
+# of shared context; suffix divergence past it cannot split the key.
+DEFAULT_KEY_BLOCKS = 8
+
+# string prompts: ~4 characters per BPE token is the usual planning
+# number; exactness is irrelevant — both sides of a shared prefix just
+# need to produce the SAME key
+CHARS_PER_TOKEN = 4
+
+
+def _flat_int_row(val) -> list | None:
+    """First flat int row of a tokens/prompt field, else None."""
+    if isinstance(val, (list, tuple)) and val:
+        if isinstance(val[0], (list, tuple)):  # batched rows: key on row 0
+            val = val[0]
+        if isinstance(val, (list, tuple)) and val and \
+                all(isinstance(t, int) for t in val):
+            return list(val)
+    return None
+
+
+def prefix_key(request: dict, *, block: int = DEFAULT_BLOCK,
+               key_blocks: int = DEFAULT_KEY_BLOCKS) -> bytes | None:
+    """Stable affinity key from a request's prompt prefix — the leading
+    ``min(whole blocks, key_blocks)`` token blocks — or None when the
+    body carries nothing routable (the router then falls back to
+    least-outstanding)."""
+    if not isinstance(request, dict):
+        return None
+    block = max(1, int(block))
+    key_blocks = max(1, int(key_blocks))
+    # client-supplied explicit prefix is part of the effective prompt:
+    # requests sharing it must co-locate with requests that inline it
+    head: list = []
+    pref = _flat_int_row(request.get("prefix"))
+    if pref:
+        # bounded like every other key ingredient: divergence past the
+        # key window must not split keys (or bloat them)
+        head.extend(pref[: key_blocks * block])
+    toks = _flat_int_row(request.get("tokens"))
+    if toks is None:
+        toks = _flat_int_row(request.get("prompt"))
+    if toks is not None:
+        seq = head + toks
+        n = min(len(seq) // block, key_blocks) * block
+        return json.dumps(seq[:n] if n else seq).encode()
+    text = request.get("text")
+    if text is None and isinstance(request.get("prompt"), str):
+        text = request["prompt"]
+    if isinstance(text, str) and text:
+        n_chars = block * CHARS_PER_TOKEN
+        n = min(len(text) // n_chars, key_blocks) * n_chars
+        if head:
+            # an explicit token prefix IS the reusable KV: requests
+            # sharing it must co-locate even with string suffixes, so
+            # the key is the prefix plus the text's WHOLE char-blocks
+            # (possibly none — short differing suffixes collapse)
+            return json.dumps(head).encode() + b"|" + text[:n].encode()
+        return text[: n if n else len(text)].encode()
+    if head:
+        # prefix-only request: same key shape as prefix + sub-block
+        # text, so it co-locates with those too
+        return json.dumps(head).encode() + b"|"
+    return None
+
+
+def pick_replica(key: bytes, names) -> str | None:
+    """Rendezvous-hash ``key`` onto one of ``names`` (any iterable of
+    replica names). Deterministic; removing a name never remaps keys
+    held by the others."""
+    best_name, best_score = None, b""
+    for name in names:
+        score = hashlib.blake2b(key + b"\x00" + str(name).encode(),
+                                digest_size=8).digest()
+        if best_name is None or score > best_score:
+            best_name, best_score = name, score
+    return best_name

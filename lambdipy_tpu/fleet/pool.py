@@ -1,0 +1,342 @@
+"""Replica-pool manager: N supervised bundle servers as one fleet.
+
+The deploy layer (runtime/deploy.py) knows how to run ONE supervised
+replica: spawn, wait for the readiness line, drain, stop.
+:class:`ReplicaPool` runs N of them as a unit the router can serve from:
+
+- **spawn** goes through the existing ``LocalRuntime``/supervisor
+  contract (one deployment per replica, watchdog on), so every
+  single-replica behavior — crash respawn with backoff, port pinning
+  across restarts, drain-before-kill — is inherited, not re-implemented;
+- a **prober thread** GETs each replica's ``/healthz`` every
+  ``probe_interval``: a connection failure (or router-reported one, see
+  :meth:`note_failure`) EJECTS the replica after ``fail_threshold``
+  consecutive failures; an ejected replica whose probes pass
+  ``readmit_passes`` times in a row (and which reports ``ready``) is
+  re-admitted — the supervisor's restart story becomes fleet-level
+  availability;
+- ``/healthz`` ``ready: false`` (boot warm in flight, or drain begun) is
+  LIVE but NOT ROUTABLE: the router stops sending before the replica
+  starts 503ing, and readiness flaps never count as failures;
+- **rolling restart** drains replicas one at a time (``/shutdown`` via
+  ``LocalRuntime.restart``, which redeploys on the SAME port), never
+  letting the routable count drop below ``live_floor``.
+
+The pool also carries the per-replica router counters
+(routed/retried/hedged/errors) so the fleet ``/metrics`` can report
+them next to the health state machine's (ejections/restarts).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from lambdipy_tpu.runtime.deploy import LocalRuntime, _http_json
+from lambdipy_tpu.utils.logs import get_logger, log_event
+
+log = get_logger("lambdipy.fleet.pool")
+
+READY = "ready"
+DRAINING = "draining"
+EJECTED = "ejected"
+STOPPED = "stopped"
+
+
+class FleetError(RuntimeError):
+    pass
+
+
+@dataclass
+class Replica:
+    """One fleet member. ``state`` is the pool's routing decision
+    (ready/draining/ejected/stopped); ``ready`` is the replica's own
+    last-reported readiness flag — both must hold to route."""
+
+    name: str
+    url: str
+    state: str = READY
+    ready: bool = True
+    managed: bool = False          # spawned through LocalRuntime by us
+    spawn_env: dict | None = None  # env to reuse on rolling restart
+    outstanding: int = 0
+    consecutive_fails: int = 0
+    consecutive_passes: int = 0
+    pid: int | None = None         # serving WORKER pid (healthz), not the
+    #                                supervisor's — changes on respawn
+    restarts: int = 0              # worker pid changes seen by the prober
+    ejections: int = 0
+    routed: int = 0
+    retried: int = 0
+    hedged: int = 0
+    errors: int = 0
+    last_health: dict = field(default_factory=dict)
+
+    @property
+    def routable(self) -> bool:
+        return self.state == READY and self.ready
+
+    def counters(self) -> dict:
+        return {
+            "url": self.url,
+            "state": self.state,
+            "ready": self.ready,
+            "outstanding": self.outstanding,
+            "routed": self.routed,
+            "retried": self.retried,
+            "hedged": self.hedged,
+            "errors": self.errors,
+            "ejections": self.ejections,
+            "restarts": self.restarts,
+            "pid": self.pid,
+        }
+
+
+class ReplicaPool:
+    def __init__(self, *, probe_interval: float = 1.0,
+                 fail_threshold: int = 1, readmit_passes: int = 2,
+                 probe_timeout: float = 5.0):
+        self.probe_interval = max(0.05, float(probe_interval))
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.readmit_passes = max(1, int(readmit_passes))
+        self.probe_timeout = float(probe_timeout)
+        self.replicas: dict[str, Replica] = {}
+        self.runtime: LocalRuntime | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- membership ---------------------------------------------------------
+
+    def attach(self, name: str, url: str) -> Replica:
+        """Register an externally managed replica (tests, or fronting
+        deployments the operator already made)."""
+        r = Replica(name=name, url=url.rstrip("/"))
+        with self._lock:
+            if name in self.replicas:
+                raise FleetError(f"replica {name!r} already in the pool")
+            self.replicas[name] = r
+        return r
+
+    def spawn(self, name: str, bundle_dir: Path, *,
+              runtime: LocalRuntime | None = None, env: dict | None = None,
+              port: int = 0, ready_timeout: float = 300.0,
+              watchdog: bool = True) -> Replica:
+        """Deploy one supervised replica and register it."""
+        if runtime is not None:
+            self.runtime = runtime
+        if self.runtime is None:
+            self.runtime = LocalRuntime()
+        dep = self.runtime.deploy(name, bundle_dir, port=port,
+                                  ready_timeout=ready_timeout, env=env,
+                                  watchdog=watchdog)
+        r = self.attach(name, dep.url)
+        r.managed = True
+        r.spawn_env = dict(env) if env else None
+        self.probe_one(r)  # fill pid/ready before the first route
+        log_event(log, "replica spawned", name=name, url=r.url)
+        return r
+
+    def spawn_fleet(self, bundle_dir: Path, n: int, *, base_name: str,
+                    runtime: LocalRuntime | None = None,
+                    env: dict | None = None,
+                    ready_timeout: float = 300.0) -> list[Replica]:
+        return [self.spawn(f"{base_name}-r{i}", bundle_dir, runtime=runtime,
+                           env=env, ready_timeout=ready_timeout)
+                for i in range(int(n))]
+
+    # -- health state machine -----------------------------------------------
+
+    def probe_one(self, r: Replica) -> bool:
+        """One health probe; returns True when the replica passed."""
+        try:
+            h = _http_json(f"{r.url}/healthz", timeout=self.probe_timeout)
+            ok = bool(h.get("ok"))
+        except Exception:  # noqa: BLE001 — refused/timeout/bad JSON all fail
+            h, ok = None, False
+        with self._lock:
+            if not ok:
+                self._fail_locked(r)
+                return False
+            r.consecutive_fails = 0
+            r.last_health = {k: h.get(k) for k in
+                             ("ready", "draining", "warming", "uptime_s")}
+            pid = h.get("pid")
+            if isinstance(pid, int):
+                if r.pid is not None and pid != r.pid:
+                    r.restarts += 1  # the supervisor respawned the worker
+                r.pid = pid
+            # servers predating the readiness split report only
+            # "draining" — treat not-draining as ready
+            r.ready = bool(h.get("ready", not h.get("draining")))
+            if r.state == EJECTED:
+                r.consecutive_passes += 1
+                if r.consecutive_passes >= self.readmit_passes and r.ready:
+                    r.state = READY
+                    r.consecutive_passes = 0
+                    log_event(log, "replica readmitted", name=r.name,
+                              pid=r.pid, restarts=r.restarts)
+        return True
+
+    def _fail_locked(self, r: Replica) -> None:
+        r.consecutive_passes = 0
+        r.consecutive_fails += 1
+        # DRAINING deliberately does NOT transition: a replica the pool
+        # is restarting is expected to stop answering mid-drain, and
+        # counting that as an ejection would make every clean rolling
+        # restart read as an outage in /metrics
+        if r.state == READY and \
+                r.consecutive_fails >= self.fail_threshold:
+            r.state = EJECTED
+            r.ejections += 1
+            log_event(log, "replica ejected", name=r.name,
+                      consecutive_fails=r.consecutive_fails)
+
+    def note_failure(self, r: Replica) -> None:
+        """Router-observed connection failure: counts like a failed probe
+        so a dead replica is ejected at traffic speed, not probe speed."""
+        with self._lock:
+            r.errors += 1
+            self._fail_locked(r)
+
+    def probe_all(self) -> None:
+        """Probe every replica CONCURRENTLY: a wedged replica that
+        accepts TCP but never answers must cost its own probe_timeout,
+        not delay every other replica's ejection/readmission behind it
+        in a serial sweep."""
+        targets = [r for r in self.replicas.values() if r.state != STOPPED]
+        if len(targets) <= 1:
+            for r in targets:
+                self.probe_one(r)
+            return
+        threads = [threading.Thread(target=self.probe_one, args=(r,),
+                                    daemon=True) for r in targets]
+        for t in threads:
+            t.start()
+        # bound the SWEEP, not the slowest probe: a wedged replica's
+        # probe keeps running (and lands its failure) in the background
+        # while the next sweep starts on schedule — otherwise one hung
+        # /healthz stretches every replica's probe period to
+        # probe_timeout
+        deadline = time.monotonic() + max(self.probe_interval, 0.5)
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    def start(self) -> "ReplicaPool":
+        def _loop():
+            while not self._stop.wait(self.probe_interval):
+                try:
+                    self.probe_all()
+                except Exception:  # noqa: BLE001 — the prober never dies
+                    pass
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="fleet-prober")
+        self._thread.start()
+        return self
+
+    # -- routing surface ----------------------------------------------------
+
+    def routable(self) -> list[Replica]:
+        with self._lock:
+            return [r for r in self.replicas.values() if r.routable]
+
+    def live_fallback(self) -> list[Replica]:
+        """READY-state replicas whose own readiness flag is false (warm
+        in flight, or drain observed on the server side). They DO serve
+        traffic — warm time-shares the device by design — so when the
+        strict routable set is empty the router degrades to these
+        instead of browning out the whole fleet (e.g. both replicas of
+        a fresh fleet warming their group-prefill programs at once)."""
+        with self._lock:
+            return [r for r in self.replicas.values()
+                    if r.state == READY and not r.ready]
+
+    def acquire(self, r: Replica) -> None:
+        with self._lock:
+            r.outstanding += 1
+
+    def release(self, r: Replica) -> None:
+        with self._lock:
+            r.outstanding = max(0, r.outstanding - 1)
+
+    def bump(self, r: Replica, counter: str, n: int = 1) -> None:
+        """Locked increment of a per-replica router counter
+        (routed/retried/hedged/errors) — concurrent handler threads must
+        not lose counts the fault-injection tests assert on."""
+        with self._lock:
+            setattr(r, counter, getattr(r, counter) + n)
+
+    def begin_drain(self, name: str) -> None:
+        """Mark a replica draining so the router stops sending BEFORE its
+        server starts 503ing new work."""
+        with self._lock:
+            self.replicas[name].state = DRAINING
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def rolling_restart(self, *, live_floor: int = 1,
+                        ready_timeout: float = 300.0,
+                        drain_grace: float = 10.0) -> None:
+        """Restart every managed replica one at a time: drain via
+        ``/shutdown``, redeploy on the SAME port, wait until it serves
+        again — the routable count never drops below ``live_floor``."""
+        managed = [r for r in self.replicas.values() if r.managed]
+        if not managed:
+            raise FleetError("no managed replicas to restart")
+        if self.runtime is None:
+            raise FleetError("pool has no LocalRuntime")
+        if live_floor > len(managed) - 1 + \
+                len([r for r in self.replicas.values() if not r.managed]):
+            raise FleetError(
+                f"live_floor={live_floor} cannot hold while restarting "
+                f"one of {len(managed)} replicas")
+        for r in managed:
+            deadline = time.monotonic() + ready_timeout
+            while len([x for x in self.routable() if x.name != r.name]) \
+                    < live_floor:
+                if time.monotonic() > deadline:
+                    raise FleetError(
+                        f"fleet below live floor {live_floor}; refusing to "
+                        f"drain {r.name}")
+                time.sleep(0.2)
+            self.begin_drain(r.name)
+            log_event(log, "rolling restart: draining", name=r.name)
+            dep = self.runtime.restart(
+                r.name, ready_timeout=ready_timeout, env=r.spawn_env,
+                grace=drain_grace)
+            with self._lock:
+                r.url = dep.url
+                r.consecutive_fails = r.consecutive_passes = 0
+            # the redeploy waited for the readiness line; one direct
+            # probe flips it routable without waiting readmit_passes
+            if self.probe_one(r):
+                with self._lock:
+                    r.state = READY
+            else:  # let the prober re-admit it through the normal path
+                with self._lock:
+                    r.state = EJECTED
+            log_event(log, "rolling restart: replica back", name=r.name,
+                      url=r.url)
+
+    def stop_all(self) -> None:
+        self.close()
+        for r in self.replicas.values():
+            if r.managed and self.runtime is not None:
+                try:
+                    self.runtime.stop(r.name)
+                except Exception:  # noqa: BLE001 — stop the rest regardless
+                    pass
+            r.state = STOPPED
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {name: r.counters()
+                    for name, r in sorted(self.replicas.items())}

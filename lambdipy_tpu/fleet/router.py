@@ -1,0 +1,621 @@
+"""Fleet front-door: prefix-affinity HTTP router over a ReplicaPool.
+
+One listening port fronting N bundle-server replicas. Per request:
+
+1. **pick** — the prompt's leading token blocks (fleet/affinity.py, same
+   block width as the radix prefix cache) rendezvous-hash to a replica,
+   so repeated prefixes land where their KV already lives; the router
+   falls back to least-outstanding-requests when the affinity target is
+   ejected, draining, or saturated (``outstanding >= saturation``), and
+   round-robins ties so affinity-off traffic actually spreads.
+2. **forward** — the body and scheduling headers (``x-priority``,
+   ``x-deadline-ms``, ``x-api-key``/``x-tenant``) pass through verbatim;
+   responses relay status, body, and ``Retry-After`` unchanged, so a
+   fleet client sees exactly the single-server contract.
+3. **retry** — a dead connection or a sched-layer shed (429/503) retries
+   on a DIFFERENT replica with jittered backoff; the backoff honors the
+   shed's ``Retry-After`` (capped), and connection failures are reported
+   to the pool so a dead replica is ejected at traffic speed. When every
+   replica shed, the LAST shed response is relayed (with its
+   ``Retry-After``) instead of a synthetic error. Generate requests are
+   stateless, so retrying is always safe; a request is only
+   non-retryable once response bytes have reached the client.
+4. **hedge** (optional) — a non-streamed request still unanswered after
+   the hedge threshold (fixed ms, or ``"p95"`` = the router's own
+   observed P95, floored) is duplicated on a second replica; the first
+   answer wins. Streamed requests never hedge (two live streams cannot
+   be reconciled) but do retry while nothing has been forwarded.
+
+Streaming (``stream: true`` on ``/invoke`` ndjson or ``/v1/completions``
+SSE) is a line-wise pass-through: the replica's chunked response is
+re-framed to the client byte-identically.
+
+``GET /metrics`` aggregates every replica's own ``/metrics`` (so the
+fleet-wide prefix-cache hit rate is one read) and adds the router's
+counters (runtime/metrics.RouterStats) plus the pool's per-replica
+state/ejection/restart counters.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from queue import Empty, Queue
+
+from lambdipy_tpu.fleet import affinity
+from lambdipy_tpu.fleet.pool import Replica, ReplicaPool
+from lambdipy_tpu.runtime.deploy import _http_json
+from lambdipy_tpu.runtime.metrics import RouterStats
+from lambdipy_tpu.utils.logs import get_logger, log_event
+
+log = get_logger("lambdipy.fleet.router")
+
+_FORWARD_HEADERS = ("x-priority", "x-deadline-ms", "x-api-key", "x-tenant")
+_ROUTED_PATHS = ("/invoke", "/v1/completions")
+
+
+class FleetRouter:
+    def __init__(self, pool: ReplicaPool, *, host: str = "127.0.0.1",
+                 port: int = 0, affinity_on: bool = True,
+                 block: int = affinity.DEFAULT_BLOCK, max_retries: int = 2,
+                 backoff_s: float = 0.05, backoff_cap_s: float = 2.0,
+                 saturation: int = 8, hedge_ms: float | str = 0,
+                 hedge_floor_ms: float = 50.0,
+                 request_timeout: float = 300.0):
+        self.pool = pool
+        self.affinity_on = bool(affinity_on)
+        self.block = max(1, int(block))
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.saturation = max(1, int(saturation))
+        self.hedge_ms = hedge_ms
+        self.hedge_floor_ms = float(hedge_floor_ms)
+        self.request_timeout = float(request_timeout)
+        self.stats = RouterStats()
+        self._rr = 0  # tie-break rotation for least-outstanding picks
+        self._rr_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- replica selection --------------------------------------------------
+
+    def _least_outstanding(self, cands: list[Replica]) -> Replica:
+        with self._rr_lock:
+            self._rr += 1
+            rot = self._rr % len(cands)
+        # rotate before min: equal-depth candidates round-robin instead
+        # of the dict-order first replica absorbing every tie
+        cands = cands[rot:] + cands[:rot]
+        return min(cands, key=lambda r: r.outstanding)
+
+    def _pick(self, key: bytes | None, exclude: set,
+              *, count_affinity: bool) -> Replica | None:
+        cands = [r for r in self.pool.routable() if r.name not in exclude]
+        if not cands:
+            # degrade to live-but-not-ready replicas (warm in flight /
+            # server-side drain flag) rather than 503ing the fleet: a
+            # warming replica serves fine, and a draining one sheds a
+            # retryable 503 — both beat a synthetic no_replica
+            cands = [r for r in self.pool.live_fallback()
+                     if r.name not in exclude]
+        if not cands:
+            return None
+        if key is not None and self.affinity_on:
+            target_name = affinity.pick_replica(
+                key, sorted(r.name for r in cands))
+            target = next(r for r in cands if r.name == target_name)
+            if target.outstanding >= self.saturation:
+                if count_affinity:
+                    self.stats.count_affinity("saturated")
+                return self._least_outstanding(cands)
+            if count_affinity:
+                # "hit" only when the full-fleet rendezvous target was
+                # routable: a pick among survivors after an ejection is
+                # affinity-consistent but not a cache-affinity hit
+                all_names = sorted(self.pool.replicas)
+                full_target = affinity.pick_replica(key, all_names)
+                self.stats.count_affinity(
+                    "hit" if full_target == target_name else "ejected")
+            return target
+        return self._least_outstanding(cands)
+
+    # -- forwarding ---------------------------------------------------------
+
+    def _fwd_headers(self, headers) -> dict:
+        out = {"Content-Type": "application/json"}
+        for h in _FORWARD_HEADERS:
+            v = headers.get(h)
+            if v:
+                out[h] = v
+        return out
+
+    def _forward(self, replica: Replica, path: str, data: bytes,
+                 headers: dict) -> tuple[int, dict, bytes]:
+        """POST to one replica; HTTP error statuses return as statuses,
+        connection-level failures raise."""
+        req = urllib.request.Request(replica.url + path, data=data,
+                                     headers=headers, method="POST")
+        self.pool.acquire(replica)
+        try:
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.request_timeout) as resp:
+                    return resp.status, dict(resp.headers), resp.read()
+            except urllib.error.HTTPError as e:
+                return e.code, dict(e.headers), e.read()
+        finally:
+            self.pool.release(replica)
+
+    @staticmethod
+    def _is_timeout(e: Exception) -> bool:
+        """A deadline expiry on an ACCEPTED request — the replica is
+        busy, not dead. Distinguished from connection failures so one
+        over-long generation neither ejects a healthy replica nor gets
+        re-sent to burn a second replica's device time."""
+        import socket
+
+        return isinstance(e, (socket.timeout, TimeoutError)) or \
+            isinstance(getattr(e, "reason", None),
+                       (socket.timeout, TimeoutError))
+
+    @staticmethod
+    def _retry_after_s(status: int, hdrs: dict, body: bytes) -> float:
+        """The shed's own backoff hint: exact float from the JSON body
+        when present, else the integer header, else 0."""
+        try:
+            parsed = json.loads(body)
+            val = parsed.get("retry_after_s")
+            if val is None:
+                val = (parsed.get("error") or {}).get("retry_after_s")
+            if val is not None:
+                return float(val)
+        except (ValueError, AttributeError):
+            pass
+        try:
+            return float(hdrs.get("Retry-After", 0))
+        except (TypeError, ValueError):
+            return 0.0
+
+    def _backoff(self, attempt: int, hint_s: float, *,
+                 others_available: bool) -> None:
+        """Jittered backoff between attempts. With another replica free
+        the retry goes immediately (the hint priced THAT replica's
+        queue, not the fleet); when rotating back, honor the hint."""
+        base = self.backoff_s * (2 ** attempt)
+        if not others_available:
+            base = max(base, hint_s)
+        delay = min(self.backoff_cap_s, base) * random.uniform(0.5, 1.0)
+        if delay > 0:
+            time.sleep(delay)
+
+    def _hedge_threshold_s(self) -> float | None:
+        if not self.hedge_ms:
+            return None
+        if self.hedge_ms == "p95":
+            p95 = self.stats.latency.percentile(95)
+            if p95 is None or self.stats.latency.count < 20:
+                return None  # not enough signal to hedge on yet
+            return max(self.hedge_floor_ms, p95) / 1e3
+        return max(float(self.hedge_ms), self.hedge_floor_ms) / 1e3
+
+    # -- request routing ----------------------------------------------------
+
+    def _route(self, handler, path: str, body: dict, raw: bytes) -> None:
+        openai = path == "/v1/completions"
+        key = (affinity.prefix_key(body, block=self.block)
+               if self.affinity_on else None)
+        headers = self._fwd_headers(handler.headers)
+        self.stats.count("requests")
+        if body.get("stream"):
+            self._route_stream(handler, path, raw, headers, key)
+            return
+        t0 = time.monotonic()
+        tried: set = set()
+        last_shed: tuple | None = None
+        attempt = 0
+        first = True
+        while attempt <= self.max_retries:
+            r = self._pick(key, tried, count_affinity=first)
+            if r is None:
+                break
+            hedge_s = self._hedge_threshold_s() if first else None
+            try:
+                if hedge_s is not None:
+                    # r becomes the ANSWERING replica: shed/tried
+                    # bookkeeping below must target whoever actually
+                    # replied, not whoever was asked first
+                    r, (status, hdrs, out) = self._forward_hedged(
+                        r, path, raw, headers, hedge_s, tried)
+                else:
+                    status, hdrs, out = self._forward(r, path, raw, headers)
+            except Exception as e:  # noqa: BLE001 — connection-level failure
+                if self._is_timeout(e):
+                    self.pool.bump(r, "errors")
+                    self.stats.count("errors")
+                    handler.send(504, {"ok": False,
+                                       "error": "upstream timeout",
+                                       "replica": r.name})
+                    return
+                self.pool.note_failure(r)
+                self.stats.count("failovers")
+                self.stats.count("retries")
+                self.pool.bump(r, "retried")
+                tried.add(r.name)
+                attempt += 1
+                first = False
+                log_event(log, "forward failed, retrying", replica=r.name,
+                          error=str(e))
+                if attempt > self.max_retries:
+                    break  # exhausted: no point sleeping before the 503
+                self._backoff(attempt, 0.0, others_available=bool(
+                    [x for x in self.pool.routable()
+                     if x.name not in tried]))
+                continue
+            first = False
+            if status in (429, 503):
+                hint = self._retry_after_s(status, hdrs, out)
+                last_shed = (status, hdrs, out)
+                tried.add(r.name)
+                attempt += 1
+                if attempt > self.max_retries:
+                    break
+                self.stats.count("retries")
+                self.pool.bump(r, "retried")
+                others = [x for x in self.pool.routable()
+                          if x.name not in tried]
+                self._backoff(attempt, hint, others_available=bool(others))
+                if not others:
+                    tried.clear()  # every replica shed: rotate back through
+                continue
+            self.pool.bump(r, "routed")
+            if status >= 500:
+                self.pool.bump(r, "errors")
+                self.stats.count("errors")
+            else:
+                self.stats.count("completed")
+                self.stats.latency.record((time.monotonic() - t0) * 1e3)
+            handler.relay(status, hdrs, out)
+            return
+        if last_shed is not None:
+            status, hdrs, out = last_shed
+            handler.relay(status, hdrs, out)
+            return
+        self.stats.count("no_replica")
+        self.stats.count("errors")
+        payload = {"error": {"message": "no routable replicas",
+                             "type": "overloaded_error"}} if openai else \
+            {"ok": False, "shed": True, "reason": "no_replica",
+             "retry_after_s": 1.0}
+        handler.send(503, payload, {"Retry-After": "1"})
+
+    def _forward_hedged(self, primary: Replica, path: str, raw: bytes,
+                        headers: dict, hedge_s: float, tried: set,
+                        ) -> tuple[Replica, tuple[int, dict, bytes]]:
+        """Send to ``primary``; if no answer within ``hedge_s``, duplicate
+        on another replica and take the first answer. Returns the
+        ANSWERING replica with its response — the caller must attribute
+        shed/tried bookkeeping to that replica, not the primary. Raises
+        only when every launched leg raised; a wait that outlives
+        ``request_timeout`` raises TimeoutError (the 504 path — legs
+        still trickling bytes are busy replicas, not dead ones)."""
+        results: Queue = Queue()
+
+        def leg(rep: Replica) -> None:
+            try:
+                results.put((rep, self._forward(rep, path, raw, headers)))
+            except Exception as e:  # noqa: BLE001 — caller attributes it
+                results.put((rep, e))
+
+        def get_result(timeout: float):
+            try:
+                return results.get(timeout=timeout)
+            except Empty:
+                raise TimeoutError(
+                    "hedged request exceeded request_timeout") from None
+
+        threading.Thread(target=leg, args=(primary,), daemon=True).start()
+        legs = 1
+        try:
+            rep, out = results.get(timeout=hedge_s)
+        except Empty:
+            second = self._pick(None, tried | {primary.name},
+                                count_affinity=False)
+            if second is not None:
+                self.stats.count("hedges")
+                self.pool.bump(second, "hedged")
+                threading.Thread(target=leg, args=(second,),
+                                 daemon=True).start()
+                legs = 2
+            rep, out = get_result(self.request_timeout)
+
+        def _bad(res) -> bool:  # dead leg or a retryable shed
+            return isinstance(res, Exception) or res[0] >= 400
+
+        if legs == 2 and _bad(out):
+            # first answer was a dead or shedding leg — wait for the
+            # other before giving up: a hedge leg's instant 429 must not
+            # discard the primary's in-flight (likely successful)
+            # response and misread a healthy replica as failed
+            rep2, out2 = get_result(self.request_timeout)
+            if isinstance(out, Exception) or \
+                    (not isinstance(out2, Exception) and not _bad(out2)):
+                rep, out = rep2, out2
+        if isinstance(out, Exception):
+            raise out
+        if legs == 2 and rep.name != primary.name and out[0] < 400:
+            self.stats.count("hedge_wins")
+        return rep, out
+
+    def _route_stream(self, handler, path: str, raw: bytes,
+                      headers: dict, key: bytes | None) -> None:
+        """Streamed pass-through: retry replicas until a response OPENS,
+        then relay line-frames; once bytes are on the wire the stream is
+        committed to that replica."""
+        t0 = time.monotonic()
+        tried: set = set()
+        last_shed: tuple | None = None
+        first = True
+        for attempt in range(self.max_retries + 1):
+            r = self._pick(key, tried, count_affinity=first)
+            first = False
+            if r is None:
+                break
+            req = urllib.request.Request(r.url + path, data=raw,
+                                         headers=headers, method="POST")
+            self.pool.acquire(r)
+            resp = None
+            try:
+                try:
+                    resp = urllib.request.urlopen(
+                        req, timeout=self.request_timeout)
+                except urllib.error.HTTPError as e:
+                    body = e.read()
+                    if e.code in (429, 503):
+                        # same shed contract as the non-streamed path:
+                        # jittered backoff honoring Retry-After, rotate
+                        # back through the fleet when everyone shed
+                        last_shed = (e.code, dict(e.headers), body)
+                        tried.add(r.name)
+                        if attempt >= self.max_retries:
+                            break  # out of attempts: relay the shed
+                            #        now, don't sleep first
+                        self.stats.count("retries")
+                        self.pool.bump(r, "retried")
+                        hint = self._retry_after_s(e.code, dict(e.headers),
+                                                   body)
+                        others = [x for x in self.pool.routable()
+                                  if x.name not in tried]
+                        self._backoff(attempt + 1, hint,
+                                      others_available=bool(others))
+                        if not others:
+                            tried.clear()
+                        continue
+                    self.pool.bump(r, "errors")
+                    self.stats.count("errors")
+                    handler.relay(e.code, dict(e.headers), body)
+                    return
+                except Exception as e:  # noqa: BLE001 — connect failure
+                    if self._is_timeout(e):
+                        self.pool.bump(r, "errors")
+                        self.stats.count("errors")
+                        handler.send(504, {"ok": False,
+                                           "error": "upstream timeout",
+                                           "replica": r.name})
+                        return
+                    self.pool.note_failure(r)
+                    self.stats.count("failovers")
+                    self.stats.count("retries")
+                    self.pool.bump(r, "retried")
+                    tried.add(r.name)
+                    log_event(log, "stream open failed, retrying",
+                              replica=r.name, error=str(e))
+                    continue
+                self.pool.bump(r, "routed")
+                handler.send_response(200)
+                handler.send_header(
+                    "Content-Type",
+                    resp.headers.get("Content-Type", "application/json"))
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                try:
+                    for line in resp:  # urllib de-chunks; line-framed body
+                        if not handler.write_frame(line):
+                            return  # client went away
+                except (OSError, http.client.HTTPException):
+                    # replica died mid-stream (FIN -> IncompleteRead,
+                    # RST -> ConnectionReset). The headers are committed,
+                    # so the only honest signal left is an UNTERMINATED
+                    # chunked body — writing the terminal chunk would
+                    # make the client's HTTP layer report the truncated
+                    # output as complete.
+                    self.pool.note_failure(r)
+                    self.stats.count("errors")
+                    handler.close_connection = True
+                    return
+                handler.end_frames()
+                self.stats.count("completed")
+                self.stats.latency.record((time.monotonic() - t0) * 1e3)
+                return
+            finally:
+                self.pool.release(r)
+                if resp is not None:
+                    try:
+                        resp.close()
+                    except OSError:
+                        pass
+        if last_shed is not None:
+            status, hdrs, out = last_shed
+            handler.relay(status, hdrs, out)
+            return
+        self.stats.count("no_replica")
+        self.stats.count("errors")
+        handler.send(503, {"ok": False, "shed": True, "reason": "no_replica",
+                           "retry_after_s": 1.0}, {"Retry-After": "1"})
+
+    # -- metrics ------------------------------------------------------------
+
+    def metrics(self) -> dict:
+        # replica scrapes fan out like the pool's probes: one wedged
+        # replica must cost its own timeout, not add probe_timeout
+        # serially to every /metrics request for each bad replica
+        per_replica: dict = {}
+
+        def scrape(name: str, url: str) -> None:
+            try:
+                per_replica[name] = _http_json(
+                    f"{url}/metrics", timeout=self.pool.probe_timeout)
+            except Exception:  # noqa: BLE001 — dead replica, no metrics
+                per_replica[name] = None
+
+        threads = [threading.Thread(target=scrape, args=(n, r.url),
+                                    daemon=True)
+                   for n, r in self.pool.replicas.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.pool.probe_timeout + 2.0)
+        agg = {"hits": 0, "misses": 0, "hit_tokens": 0}
+        for name in sorted(self.pool.replicas):
+            m = per_replica.setdefault(name, None)
+            if m is None:
+                continue
+            pc = (m.get("handler") or {}).get("prefix_cache")
+            if isinstance(pc, dict):
+                for k in agg:
+                    agg[k] += int(pc.get(k, 0))
+        total = agg["hits"] + agg["misses"]
+        routable = self.pool.routable()
+        return {
+            "router": self.stats.report(),
+            "pool": self.pool.report(),
+            "fleet": {
+                "replicas": len(self.pool.replicas),
+                "routable": len(routable),
+                "outstanding": sum(r.outstanding
+                                   for r in self.pool.replicas.values()),
+                "prefix_cache": {
+                    **agg,
+                    "hit_rate": (round(agg["hits"] / total, 4)
+                                 if total else 0.0),
+                },
+            },
+            "replicas": per_replica,
+        }
+
+    # -- HTTP plumbing ------------------------------------------------------
+
+    def _make_handler(router_self):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug(fmt % args)
+
+            def send(self, code: int, payload: dict,
+                     headers: dict | None = None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except OSError:
+                    self.close_connection = True
+
+            def relay(self, status: int, hdrs: dict, body: bytes):
+                """Relay a replica response verbatim (status, body,
+                content type, and the shed contract's Retry-After)."""
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 hdrs.get("Content-Type",
+                                          "application/json"))
+                self.send_header("Content-Length", str(len(body)))
+                if hdrs.get("Retry-After"):
+                    self.send_header("Retry-After", hdrs["Retry-After"])
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except OSError:
+                    self.close_connection = True
+
+            def write_frame(self, body: bytes) -> bool:
+                try:
+                    self.wfile.write(f"{len(body):x}\r\n".encode())
+                    self.wfile.write(body)
+                    self.wfile.write(b"\r\n")
+                    return True
+                except OSError:
+                    self.close_connection = True
+                    return False
+
+            def end_frames(self) -> None:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    self.close_connection = True
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    pool = router_self.pool
+                    routable = pool.routable()
+                    self.send(200, {
+                        "ok": bool(routable),
+                        "router": True,
+                        "routable": len(routable),
+                        "replicas": {n: r.state
+                                     for n, r in sorted(
+                                         pool.replicas.items())},
+                        "affinity": router_self.affinity_on,
+                        "block": router_self.block,
+                    })
+                elif self.path == "/metrics":
+                    self.send(200, router_self.metrics())
+                else:
+                    self.send(404, {"ok": False, "error": "not found"})
+
+            def do_POST(self):
+                if self.path not in _ROUTED_PATHS:
+                    self.send(404, {"ok": False, "error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length) or b"{}"
+                    body = json.loads(raw)
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self.send(400, {"ok": False,
+                                    "error": f"bad request: {e}"})
+                    return
+                router_self._route(self, self.path, body, raw)
+
+        return Handler
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def serve_forever(self):
+        log_event(log, "fleet router serving", port=self.port,
+                  replicas=len(self.pool.replicas),
+                  affinity=self.affinity_on)
+        self._httpd.serve_forever()
+
+    def start_background(self) -> "FleetRouter":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -189,6 +189,20 @@ class LocalRuntime:
     def metrics(self, name: str) -> dict:
         return _http_json(f"{self.get(name).url}/metrics")
 
+    def restart(self, name: str, *, ready_timeout: float = 300.0,
+                env: dict | None = None, watchdog: bool = True,
+                grace: float = 5.0) -> Deployment:
+        """Drain + stop, then redeploy the same bundle pinned to the SAME
+        port, so anything holding the deployment's URL (the fleet
+        router's replica table) stays valid across the restart. This is
+        the rolling-restart primitive ``ReplicaPool.rolling_restart``
+        drains the fleet with."""
+        dep = self.get(name)
+        self.stop(name, grace=grace)
+        return self.deploy(name, Path(dep.bundle_dir), port=dep.port,
+                           ready_timeout=ready_timeout, env=env,
+                           watchdog=watchdog)
+
     def stop(self, name: str, *, grace: float = 5.0) -> None:
         """Drain via /shutdown, escalate to SIGTERM, then SIGKILL the whole
         process group (deploys start a new session, so this reaps the

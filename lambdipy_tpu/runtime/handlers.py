@@ -33,6 +33,12 @@ class HandlerState:
     # the SUFFIX a request will actually prefill (runtime/server.py) —
     # without this, deadline shedding over-rejects cache-hit requests.
     prefix_probe: Callable[[Any], int] | None = None
+    # optional O(1) readiness probe: True while a background warm
+    # (bucket / group-prefill compiles) is in flight. /healthz reads
+    # THIS — not the full stats() document — once per fleet probe
+    # interval, so it must stay a bare flag read, no locks or
+    # serialization.
+    warming_fn: Callable[[], bool] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
@@ -470,7 +476,11 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     # Progress rides /metrics (handler.warm_buckets).
     import threading
 
-    warm_state = {"requested": [], "done": [], "errors": []}
+    # "in_flight" is the readiness signal /healthz exposes: True from the
+    # moment the warm thread is committed until it finishes, so a fleet
+    # router can hold traffic off a still-compiling replica
+    warm_state = {"requested": [], "done": [], "errors": [],
+                  "in_flight": False}
     raw_buckets = extra.get("warm_buckets")
     if server is not None and raw_buckets:
         warm_state["requested"] = sorted(
@@ -492,6 +502,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             if _warm_started:
                 return
             _warm_started = True
+            # flipped before the thread exists: no window where warm is
+            # committed but a /healthz probe still reads ready
+            warm_state["in_flight"] = True
 
         def _warm_buckets():
             # warm traffic time-shares the one device with foreground
@@ -522,6 +535,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 server.aot_save_all()
             except Exception:  # noqa: BLE001 — AOT is best-effort
                 pass
+            with _warm_lock:
+                warm_state["in_flight"] = False
 
         threading.Thread(target=_warm_buckets, daemon=True,
                          name="bucket-warm").start()
@@ -937,6 +952,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         invoke_stream_fn=invoke_stream if server is not None else None,
         prefix_probe=(prefix_store.match_len
                       if prefix_store is not None else None),
+        # bare dict read — GIL-atomic, no lock: exactly what a
+        # once-per-probe-interval health check may cost
+        warming_fn=lambda: bool(warm_state["in_flight"]),
         meta={
             "model": spec["model"], "quant": spec.get("quant"),
             "sharded": mesh is not None, "tokenizer": tokenizer is not None,

@@ -111,6 +111,73 @@ class DecodeWindowStats:
 
 
 @dataclass
+class RouterStats:
+    """Counters for the fleet front-door (fleet/router.py), exported on
+    the router's ``/metrics`` under ``router``. ``retries`` counts
+    re-sends after a retryable failure (connection loss or a 429/503
+    shed), ``failovers`` the subset caused by a dead connection;
+    ``hedges``/``hedge_wins`` track duplicate sends for slow requests
+    and how often the duplicate answered first. The ``affinity_*``
+    counters measure prefix-affinity routing: a hit means the request
+    reached its rendezvous-hash target; fallbacks record why it did not
+    (target ejected/busy). ``latency`` is the router-observed end-to-end
+    distribution — the P9x basis for the hedging threshold."""
+
+    requests: int = 0
+    completed: int = 0
+    errors: int = 0
+    retries: int = 0
+    failovers: int = 0
+    hedges: int = 0
+    hedge_wins: int = 0
+    no_replica: int = 0
+    affinity_requests: int = 0
+    affinity_hits: int = 0
+    affinity_fallbacks: dict = field(default_factory=dict)  # reason -> n
+    latency: LatencyStats = field(default_factory=LatencyStats)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def count(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def count_affinity(self, outcome: str) -> None:
+        """``outcome``: 'hit', or a fallback reason ('saturated',
+        'ejected', ...). Every call is one affinity-keyed request."""
+        with self._lock:
+            self.affinity_requests += 1
+            if outcome == "hit":
+                self.affinity_hits += 1
+            else:
+                self.affinity_fallbacks[outcome] = \
+                    self.affinity_fallbacks.get(outcome, 0) + 1
+
+    def report(self) -> dict:
+        with self._lock:
+            aff = dict(self.affinity_fallbacks)
+            out = {
+                "requests": self.requests,
+                "completed": self.completed,
+                "errors": self.errors,
+                "retries": self.retries,
+                "failovers": self.failovers,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "no_replica": self.no_replica,
+                "affinity": {
+                    "requests": self.affinity_requests,
+                    "hits": self.affinity_hits,
+                    "hit_rate": (round(self.affinity_hits
+                                       / self.affinity_requests, 4)
+                                 if self.affinity_requests else 0.0),
+                    "fallbacks": aff,
+                },
+            }
+        out["latency"] = self.latency.report()
+        return out
+
+
+@dataclass
 class PrefixCacheStats:
     """Counters for the automatic cross-request prefix KV cache: a
     request whose prompt longest-prefix-matches the radix tree is a hit

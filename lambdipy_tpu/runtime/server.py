@@ -237,8 +237,26 @@ class BundleServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    # liveness vs readiness split: "ok" is liveness (the
+                    # process answers — always 200 so watchdog tooling
+                    # keeps working), "ready" says ROUTE TO ME. A
+                    # replica reports ready: false while the background
+                    # warmup/group-prefill is still compiling or once
+                    # drain has begun, so the fleet router deprioritizes
+                    # it BEFORE the 503s start. warming_fn is the
+                    # handler's O(1) flag — NOT the full stats()
+                    # document, which takes the serving path's locks
+                    # and would be recomputed every probe interval.
+                    warming_fn = getattr(server_self.boot.state,
+                                         "warming_fn", None)
+                    try:
+                        warming = bool(warming_fn()) if warming_fn else False
+                    except Exception:  # noqa: BLE001 — health never 500s
+                        warming = False
                     self._send(200, {
                         "ok": True,
+                        "ready": not server_self.draining and not warming,
+                        "warming": warming,
                         "pid": os.getpid(),
                         "draining": server_self.draining,
                         "bundle": str(server_self.bundle_dir),

@@ -30,8 +30,11 @@ from lambdipy_tpu.utils.logs import get_logger, log_event
 
 log = get_logger("lambdipy.supervisor")
 
-STABLE_UPTIME_S = 60.0  # a run this long resets the consecutive-failure count
-MAX_BACKOFF_S = 10.0
+# A run this long resets the consecutive-failure count; env-tunable so
+# fleet fault-injection tests (and operators with fast-booting bundles)
+# can shrink the window without patching the module.
+STABLE_UPTIME_S = float(os.environ.get("LAMBDIPY_STABLE_UPTIME_S", "60"))
+MAX_BACKOFF_S = float(os.environ.get("LAMBDIPY_MAX_BACKOFF_S", "10"))
 
 
 def _spawn(bundle: str, port: int) -> subprocess.Popen:
