@@ -42,8 +42,53 @@ def _stage_timeout(stage: str, platform: str) -> float:
     if stage == "decode8b":
         # 8 GB weight upload + a 32-layer program compile
         return float(os.environ.get("LAMBDIPY_BENCH_8B_TIMEOUT", "1500"))
+    if stage == "devices":
+        # the first probe is pure device enumeration (no model compile):
+        # a wedged transport deserves a SHORT leash here, because this
+        # stage is where every run of a dead tunnel burns its wait
+        # (BENCH_r04/r05 paid 240 s per invocation before the fallback)
+        return float(os.environ.get(
+            "LAMBDIPY_DEVICE_PROBE_TIMEOUT_S",
+            os.environ.get("LAMBDIPY_BENCH_PROBE_TIMEOUT", "60")))
     # probes only pay interpreter+PJRT init (~10 s) plus one small compile
     return float(os.environ.get("LAMBDIPY_BENCH_PROBE_TIMEOUT", "240"))
+
+
+def _wedge_verdict_path() -> str:
+    cache_dir = os.environ.get(
+        "LAMBDIPY_BENCH_CACHE",
+        os.path.expanduser("~/.lambdipy-tpu/cache/bench-compile"))
+    return os.path.join(cache_dir, "device-wedge.json")
+
+
+def _read_cached_wedge() -> str | None:
+    """A still-fresh wedge verdict recorded by a previous bench
+    invocation, or None. Repeated bench runs against a dead transport
+    skip the device attempt instead of re-burning the probe timeout
+    each time; LAMBDIPY_BENCH_WEDGE_TTL (seconds, default 600, 0
+    disables) bounds how long a verdict is trusted."""
+    ttl = float(os.environ.get("LAMBDIPY_BENCH_WEDGE_TTL", "600"))
+    if ttl <= 0:
+        return None
+    try:
+        with open(_wedge_verdict_path()) as f:
+            rec = json.load(f)
+        age = time.time() - float(rec["at"])
+        if 0 <= age < ttl:
+            return f"{rec['error']} [cached verdict, {age:.0f}s old]"
+    except Exception:  # noqa: BLE001 — missing/corrupt cache = no verdict
+        return None
+    return None
+
+
+def _write_wedge_verdict(error: str) -> None:
+    try:
+        path = _wedge_verdict_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"error": error, "at": time.time()}, f)
+    except Exception:  # noqa: BLE001 — the cache is an optimization
+        pass
 
 
 def _maybe_wedge(stage: str) -> None:
@@ -621,6 +666,147 @@ def decode_window_record(*, lens=(16, 48, 200), cache_len: int = 256,
     }
 
 
+def pipeline_record(*, depths=(1, 2), rtts_ms=(0.0, 20.0, 66.0),
+                    n_requests: int = 2, prompt_len: int = 12,
+                    n_new: int = 64, segment: int = 16, slots: int = 4,
+                    reps: int = 2, extra: dict | None = None) -> dict:
+    """Pipelined-engine sweep (CPU-runnable): the same concurrent
+    workload decodes through the continuous engine at each
+    ``pipeline_depth``, with a SYNTHETIC per-fetch RTT injected into the
+    collector to model the remote-tunnel transport (the ~66 ms per
+    ``device_get`` the engine comment records; the sleep starts after
+    device compute completes and stalls only that fetch, exactly like a
+    tunnel RTT). Asserts BITWISE token parity across depths (and vs the
+    solo server), and that depth 2 beats depth 1 on tok/s at every
+    synthetic RTT >= 20 ms — the pipelining claim: with >= 2 segments in
+    flight, device compute hides under the fetch + host-bookkeeping
+    window that a depth-1 loop serializes. Reports per-depth tok/s,
+    overlap ratio and the ``batching.pipeline`` counters."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    dims = {"vocab_size": 2048, "hidden": 128, "layers": 2, "heads": 4,
+            "kv_heads": 2, "mlp": 256,
+            "max_len": max(256, 2 * (prompt_len + n_new))}
+    dims.update(extra or {})
+    adapter = registry.get("llama3-8b").build(dtype="float32", extra=dims)
+    params = jax.device_put(adapter.init_params(seed=0))
+    server = adapter.make_server(params)
+
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(1, adapter.config.vocab_size, prompt_len).tolist()
+            for _ in range(n_requests)]
+    solo = [server.generate(r, max_new_tokens=n_new) for r in rows]
+
+    def run_engine(depth: int, rtt: float):
+        engine = ContinuousBatcher(server, slots=slots, segment=segment,
+                                   pipeline_depth=depth,
+                                   synthetic_fetch_rtt_ms=rtt)
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(max_workers=n_requests) as ex:
+            outs = list(ex.map(
+                lambda row: engine.generate(row, max_new_tokens=n_new),
+                rows))
+        wall = time.monotonic() - t0
+        # generate() returns when the collector marks the last row done,
+        # but the engine thread may still be draining up to depth-1
+        # garbage segments (each paying the synthetic RTT) before it
+        # closes the episode — wait for idle so the reported pipeline
+        # counters are complete, while tok/s stays the client-visible
+        # wall measured above
+        with engine._lock:
+            while engine._engine_running:
+                engine._lock.wait(0.05)
+        return outs, wall, engine.stats()
+
+    # warm off the clock: compile the group prefill, pack, and every
+    # window-bucket segment variant this workload dispatches (the
+    # position sequence is identical across the timed runs)
+    for depth in sorted(set(depths)):
+        run_engine(depth, 0.0)
+
+    total_new = n_requests * n_new
+    rows_rec = []
+    for rtt in sorted(rtts_ms):
+        per = {}
+        for depth in sorted(set(depths)):
+            best = None
+            for _ in range(max(1, reps)):
+                outs, wall, stats = run_engine(depth, rtt)
+                for i, out in enumerate(outs):
+                    if not np.array_equal(out, solo[i]):
+                        raise AssertionError(
+                            f"pipeline parity broke: depth={depth} "
+                            f"rtt={rtt}ms request {i} tokens != solo")
+                if best is None or wall < best[0]:
+                    best = (wall, stats)
+            wall, stats = best
+            pipe = stats["pipeline"]
+            per[depth] = {
+                "tok_s": round(total_new / wall, 1),
+                "wall_ms": round(wall * 1e3, 1),
+                "overlap_ratio": pipe["overlap_ratio"],
+                "in_flight": pipe["in_flight"],
+                "wasted_overdecode_tokens":
+                    pipe["wasted_overdecode_tokens"],
+                "drains": pipe["drains"],
+            }
+        rec = {"rtt_ms": rtt,
+               "depths": {str(d): v for d, v in per.items()}}
+        if 1 in per and 2 in per:
+            rec["speedup_d2_vs_d1"] = round(
+                per[2]["tok_s"] / per[1]["tok_s"], 3)
+            if rtt >= 20.0 and per[2]["tok_s"] <= per[1]["tok_s"]:
+                # the load-bearing claim: with a nonzero fetch RTT the
+                # double-buffered loop must beat the synchronous one
+                raise AssertionError(
+                    f"pipeline depth 2 regressed below depth 1 at "
+                    f"synthetic RTT {rtt}ms: {per[2]['tok_s']} <= "
+                    f"{per[1]['tok_s']} tok/s")
+        rows_rec.append(rec)
+    return {
+        "mode": "pipeline",
+        "platform": jax.devices()[0].platform,
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "n_new": n_new,
+        "segment": segment,
+        "slots": slots,
+        "parity": True,
+        "rows": rows_rec,
+    }
+
+
+def _pipeline_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pipeline", action="store_true")
+    ap.add_argument("--depths", type=str, default="1,2")
+    ap.add_argument("--rtts-ms", type=str, default="0,20,66")
+    ap.add_argument("--requests", type=int, default=2)
+    ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--n-new", type=int, default=64)
+    ap.add_argument("--segment", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=2)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(pipeline_record(
+        depths=tuple(int(x) for x in args.depths.split(",")),
+        rtts_ms=tuple(float(x) for x in args.rtts_ms.split(",")),
+        n_requests=args.requests, prompt_len=args.prompt_len,
+        n_new=args.n_new, segment=args.segment, slots=args.slots,
+        reps=args.reps)))
+    return 0
+
+
 def _decode_window_main() -> int:
     import argparse
 
@@ -754,6 +940,11 @@ def main() -> int:
         # CPU-runnable decode-window sweep: parity + monotone KV-read
         # savings from the length-aware windowed decode path
         return _decode_window_main()
+    if "--pipeline" in sys.argv:
+        # CPU-runnable pipelined-engine sweep: bitwise parity across
+        # pipeline depths + depth-2 tok/s beating depth-1 under a
+        # synthetic per-fetch transport RTT
+        return _pipeline_main()
     if "--fleet" in sys.argv:
         # CPU-runnable fleet sweep: N replicas behind the affinity
         # router vs one direct — parity + affinity/prefix hit rates
@@ -785,10 +976,26 @@ def main() -> int:
         env["LAMBDIPY_BENCH_ATTEMPT"] = label
         platform = env.get("LAMBDIPY_PLATFORM") or "device"
         result = None
+        if label == "device" and len(attempts) > 1:
+            # a previous invocation already diagnosed this transport as
+            # wedged: skip straight to the fallback instead of burning
+            # the probe timeout again (the verdict file carries a TTL).
+            # Only when a fallback attempt exists — an operator's
+            # explicit LAMBDIPY_PLATFORM pin (e.g. cpu) runs a single
+            # attempt that has nothing to do with the wedged tunnel the
+            # verdict diagnosed, and skipping it would fail the run
+            # outright
+            cached = _read_cached_wedge()
+            if cached is not None:
+                stages_log["device.devices"] = cached
+                continue
         for stage in STAGES:
             data, err = _run_stage(stage, env, platform)
             if err is not None:
                 stages_log[f"{label}.{stage}"] = err
+                if label == "device" and stage == "devices" \
+                        and "wedge" in err:
+                    _write_wedge_verdict(err)
                 break
             stages_log[f"{label}.{stage}"] = "ok"
             if stage == "model":

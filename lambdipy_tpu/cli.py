@@ -458,9 +458,16 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
 @click.option("--prefix-block", type=int, default=None,
               help="token-block granularity of prefix reuse (rounded "
                    "to a pow-2 dividing the context window; default 32)")
+@click.option("--pipeline-depth", type=int, default=None,
+              help="decode segments kept in flight on the device before "
+                   "the host fetches the oldest (continuous engine): 1 "
+                   "= synchronous dispatch-fetch-book loop, >= 2 "
+                   "overlaps device compute with the fetch RTT + host "
+                   "bookkeeping (default: bundle pipeline_depth, "
+                   "else 2)")
 def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
-              prefix_block):
+              prefix_block, pipeline_depth):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
@@ -471,6 +478,8 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_PREFIX_CACHE_MB"] = str(prefix_cache_mb)
     if prefix_block is not None:
         os.environ["LAMBDIPY_PREFIX_BLOCK"] = str(prefix_block)
+    if pipeline_depth is not None:
+        os.environ["LAMBDIPY_PIPELINE_DEPTH"] = str(pipeline_depth)
     # BundleServer resolves the effective policy (bundle extra <
     # LAMBDIPY_SCHED_POLICY env < these flags) and bridges it to the
     # handler's batch formation itself — no env plumbing needed here

@@ -23,10 +23,24 @@ Design (all device work rides LlamaServer's compiled-program cache):
   pack it into a free slot with a jitted per-leaf
   ``dynamic_update_slice`` at the slot index (one compile total: the
   slot is a traced operand).
-- The engine thread loops: pack waiting joiners -> run one segment ->
-  fetch the [B, segment] token block -> deliver each active row's slice
-  -> retire rows that finished (their max_new reached, or their eos
-  seen). It exits when idle and restarts on the next request.
+- The engine thread is PIPELINED (``pipeline_depth``, default 2):
+  dispatch is async in JAX and the carry threads device-side, so the
+  loop dispatches segment N+1 immediately after segment N's dispatch
+  returns and a COLLECTOR stage drains completed segments behind the
+  dispatch frontier — fetch the [B, segment] token block (one host RTT
+  on a remote transport), deliver each active row's slice, mark rows
+  that finished (max_new reached, or eos seen in the newly appended
+  block). Device compute therefore overlaps the host fetch + bookkeeping
+  window instead of idling through it. Slot retirement and joiner
+  packing happen only at pipeline-drain BARRIERS (pipeline empty): a row
+  that finishes mid-pipeline keeps its slot as a garbage row until the
+  next barrier and the blocks dispatched past its finish are discarded
+  host-side (counted as ``wasted_overdecode_tokens``), so outputs stay
+  bitwise identical to the synchronous ``pipeline_depth=1`` loop; a
+  pending joiner forces a bounded drain (at most ``pipeline_depth - 1``
+  in-flight segments) so packing sees host-truth slots and a
+  host-materialized carry. The engine exits when idle and restarts on
+  the next request.
 - Per-row independence makes this exact: each row's attention reads only
   its own cache row and position (models/llama.py ragged decode), so a
   row's greedy tokens are identical whether it decodes solo or packed
@@ -66,10 +80,12 @@ class ContinuousBatcher:
     def __init__(self, server: Any, *, slots: int = 8, segment: int = 16,
                  cache_len: int | None = None,
                  group_prefill_max: int = 256, policy: Any = None,
-                 window_bucketing: bool = True):
+                 window_bucketing: bool = True, pipeline_depth: int = 2,
+                 synthetic_fetch_rtt_ms: float = 0.0):
         import jax
 
-        from lambdipy_tpu.runtime.metrics import DecodeWindowStats
+        from lambdipy_tpu.runtime.metrics import (DecodeWindowStats,
+                                                  PipelineStats)
 
         self.server = server
         cfg = server.model.cfg
@@ -84,6 +100,18 @@ class ContinuousBatcher:
         # plain segment program still serves windows at the cache cap.
         self.window_bucketing = bool(window_bucketing)
         self.window_stats = DecodeWindowStats()
+        # segments kept in flight on the device before the host fetches
+        # the oldest: 1 = the fully synchronous loop (dispatch, fetch,
+        # book, repeat — the device idles through every fetch RTT +
+        # host window), >= 2 overlaps device compute with the collector
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self.pipeline_stats = PipelineStats(depth=self.pipeline_depth)
+        # bench-only transport model (bench.py --pipeline): each collect
+        # pays this extra RTT after device compute completes, like a
+        # remote-tunnel device_get, WITHOUT stalling other queued
+        # segments — lets a CPU sweep show what pipelining buys at a
+        # given transport latency
+        self.synthetic_fetch_rtt_ms = max(0.0, float(synthetic_fetch_rtt_ms))
         # sched policy: when slots are scarce, waiting joiners are packed
         # in POLICY order (priority / fair-share by request class from
         # the scheduler's context) instead of arrival order; None = FIFO
@@ -322,7 +350,12 @@ class ContinuousBatcher:
         except Exception as e:  # noqa: BLE001 — waiters must never hang
             log.error("continuous-batch engine failed: %s", e)
             with self._lock:
-                for entry in self._joiners + [a for a in self._active if a]:
+                # a row that already completed mid-pipeline (done=True,
+                # slot held as garbage until the next drain barrier) has
+                # a bitwise-valid result — don't overwrite it with the
+                # engine error its waiter would then raise
+                for entry in self._joiners + [a for a in self._active
+                                              if a and not a["done"]]:
                     entry["error"] = e
                     entry["done"] = True
                 self._joiners.clear()
@@ -332,6 +365,9 @@ class ContinuousBatcher:
                 self._lock.notify_all()
 
     def _engine_body(self):
+        import time
+        from collections import deque
+
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -344,132 +380,258 @@ class ContinuousBatcher:
         # sampling knobs are PER-SLOT vectors rebuilt before each
         # segment from the active rows' own requests
         eos_op = jnp.full((self.slots,), -1, jnp.int32)
-        while True:
-            with self._lock:
-                free = [i for i, a in enumerate(self._active) if a is None]
-                if self._joiners and free:
-                    # slot handoff dequeues by policy: under slot
-                    # contention the scheduling class (not arrival
-                    # order) decides who joins the in-flight batch next
-                    ordered = (self.policy.order(list(self._joiners))
-                               if self.policy is not None
-                               else list(self._joiners))
-                    for joiner in ordered:
-                        if not free:
-                            break
-                        self._joiners.remove(joiner)
-                        joiner["slot"] = free.pop(0)
-                        self._active[joiner["slot"]] = joiner
-                packing = [a for a in self._active
-                           if a is not None and not a.get("packed")]
-                if not any(self._active):
-                    # idle: engine exits; next request restarts it
-                    self._engine_running = False
-                    self._lock.notify_all()
-                    return
-            if self._carry is None:
-                self._carry = self._init_carry()
-            raw = [a for a in packing if a.get("carry") is None]
-            carried = [a for a in packing if a.get("carry") is not None]
-            group_carry = None
-            if raw:
-                try:
-                    group_carry = self._prefill_group(raw)
-                    with self._lock:
-                        self.prefill_groups += 1
-                        self.rows_group_prefilled += len(raw)
-                except Exception as e:  # noqa: BLE001
-                    # a group-prefill failure (fresh-bucket compile
-                    # OOM, transient device error) errors ONLY the raw
-                    # joiners — in-flight decode and carried joiners
-                    # keep running, matching the isolation request-
-                    # thread prefill used to provide
-                    log.error("group prefill failed: %s", e)
-                    with self._lock:
-                        for j in raw:
-                            j["error"], j["done"] = e, True
-                            self._active[j["slot"]] = None
-                        self._lock.notify_all()
-                    raw = []
-            for src, joiner in enumerate(raw):
-                self._carry = self._pack(self._carry, group_carry, src,
-                                         joiner["slot"])
-                joiner["packed"] = True
-            group_carry = None  # free the group cache
-            for joiner in carried:
-                self._carry = self._pack(self._carry, joiner["carry"], 0,
-                                         joiner["slot"])
-                joiner["carry"] = None  # free the 1-row cache
-                joiner["packed"] = True
-            with self._lock:
-                t_host = np.zeros((self.slots,), np.float32)
-                k_host = np.zeros((self.slots,), np.int32)
-                p_host = np.ones((self.slots,), np.float32)
-                positions = []  # active rows' pre-segment decode positions
-                for slot, e in enumerate(self._active):
-                    if e is not None:
-                        t_host[slot] = e["temperature"] or 0.0
-                        k_host[slot] = e["top_k"] or 0
-                        p_host[slot] = (1.0 if e["top_p"] is None
-                                        else e["top_p"])
-                        positions.append(e["pos0"] + len(e["toks"]))
-            # window bucketing: the segment's furthest write lands at
-            # max(pos) + segment - 1, so a pow-2 window >= max(pos) +
-            # segment keeps every active row's reads/writes in bounds
-            # and the output bitwise the full-window program's. Retired
-            # slots' garbage rows may hold larger stale positions; their
-            # out-of-window scatters drop harmlessly (nothing reads them).
-            window = self.cache_len
-            if self.window_bucketing and positions:
-                needed = max(positions) + self.segment
-                window = min(_next_bucket(needed, 16), self.cache_len)
-            if window < self.cache_len:
-                seg = server._windowed_seg_fn(self.slots, self.cache_len,
-                                              window, self.segment)
-            else:
-                seg = seg_full
-            with server._mesh_ctx():
-                (toks, lps), self._carry = seg(
-                    server.params, jnp.asarray(t_host),
-                    jnp.asarray(k_host), jnp.asarray(p_host),
-                    *self._carry, eos_op)
-            # attended = per-row sum of positions each step's attention
-            # actually covered (pos + 1 keys at write index pos)
-            self.window_stats.record_segment(
-                attended=sum(self.segment * p
-                             + self.segment * (self.segment + 1) // 2
-                             for p in positions),
-                window_read=len(positions) * self.segment * window,
-                full_window=len(positions) * self.segment * self.cache_len,
-                window=window)
+        pstats = self.pipeline_stats
+        # dispatched-but-not-fetched segments, oldest first; each record
+        # snapshots what the host needs to book the result later: the
+        # slot -> entry mapping and the window accounting AT DISPATCH
+        # time (the window was chosen then — recording it at collect
+        # keeps DecodeWindowStats truthful about queued segments)
+        inflight: deque = deque()
+        ep_t0 = time.monotonic()
+        # mark the episode open so report()'s wall (and overlap_ratio)
+        # includes the in-progress episode: under sustained traffic the
+        # engine may never go idle, and a /metrics scrape mid-episode
+        # must not divide device_busy_s by only the COMPLETED episodes'
+        # wall (0.0 on the first, > 1.0 ratios later)
+        pstats.begin_episode(ep_t0)
+
+        def collect_one():
+            """The collector stage: fetch the OLDEST in-flight segment
+            and do its host bookkeeping — token append, incremental eos
+            scan, done marking. Runs behind the dispatch frontier, so
+            on pipeline_depth >= 2 the device is computing the next
+            segment during this fetch + bookkeeping window."""
+            rec = inflight.popleft()
+            # compute-ready marker for the overlap ratio: the device is
+            # done with this segment here; whatever the fetch costs past
+            # this point (transport RTT) only keeps the device busy if
+            # another segment is queued behind it. (On the remote tunnel
+            # block_until_ready returns at submission — there the marker
+            # undercounts busy time, which is the conservative side.)
+            jax.block_until_ready(rec["toks"])
+            t_ready = time.monotonic()
+            if self.synthetic_fetch_rtt_ms > 0:
+                # transport model: the RTT starts once device compute is
+                # done and blocks only THIS fetch — segments already
+                # queued behind it keep the device busy meanwhile
+                time.sleep(self.synthetic_fetch_rtt_ms / 1e3)
             # one host fetch per segment: on a remote-tunnel transport
             # every device_get of a fresh result pays one RTT (~66 ms
             # measured), so the logprob block rides the same fetch — and
             # only when some active request actually asked for it
-            with self._lock:
-                need_lp = any(a is not None and a["want_lp"]
-                              for a in self._active)
-            if need_lp:
+            if rec["need_lp"]:
                 block, lp_block = map(np.asarray,
-                                      jax.device_get((toks, lps)))
+                                      jax.device_get((rec["toks"],
+                                                      rec["lps"])))
             else:
-                block, lp_block = np.asarray(jax.device_get(toks)), None
+                block = np.asarray(jax.device_get(rec["toks"]))
+                lp_block = None
+            t_end = time.monotonic()
+            self.window_stats.record_segment(
+                attended=rec["attended"], window_read=rec["window_read"],
+                full_window=rec["full_window"], window=rec["window"])
+            wasted = 0
             with self._lock:
                 self.segments_run += 1
-                for slot, entry in enumerate(self._active):
-                    if entry is None:
+                for slot, entry in rec["rows"]:
+                    if entry["done"]:
+                        # over-decode: this block was dispatched before
+                        # the row's finish became host-visible — discard
+                        # the tail so output stays bitwise the depth-1
+                        # engine's
+                        wasted += len(block[slot])
                         continue
                     self.rows_in_segments += 1
+                    base = len(entry["toks"])
                     entry["toks"].extend(block[slot].tolist())
                     if lp_block is not None:
                         entry["lps"].extend(lp_block[slot].tolist())
                     eos, n = entry["eos_id"], entry["n"]
-                    hit_eos = eos is not None and eos in entry["toks"]
-                    if hit_eos or len(entry["toks"]) >= n:
+                    if eos is not None and entry["eos_at"] is None \
+                            and eos in block[slot]:
+                        # scan only the newly appended block (the old
+                        # `eos in entry["toks"]` rescan was O(n^2) over
+                        # a long decode) and record the first-hit index
+                        # so truncation needs no second scan
+                        entry["eos_at"] = base + \
+                            entry["toks"][base:].index(eos)
+                    if entry["eos_at"] is not None \
+                            or len(entry["toks"]) >= n:
                         entry["done"] = True
-                        self._active[slot] = None
                         self.requests_served += 1
                 self._lock.notify_all()
+            # fetch clock starts AFTER block_until_ready so fetch_block_s
+            # measures only the device_get transport window (plus the
+            # bench-only synthetic RTT), not the device-compute wait the
+            # collector pays when it outruns the device
+            pstats.record_collect(rec["t_dispatch"], t_ready,
+                                  fetch_s=t_end - t_ready, wasted=wasted)
+
+        try:
+            while True:
+                # ---- barrier: the pipeline is EMPTY here. Slot
+                # retirement and joiner packing only happen at these
+                # drain barriers, so in-flight segments never see their
+                # slot repurposed under them. ----
+                with self._lock:
+                    for slot, e in enumerate(self._active):
+                        if e is not None and e["done"]:
+                            # finished mid-pipeline: the slot decoded as
+                            # a garbage row until this barrier; free it
+                            self._active[slot] = None
+                    free = [i for i, a in enumerate(self._active)
+                            if a is None]
+                    if self._joiners and free:
+                        # slot handoff dequeues by policy: under slot
+                        # contention the scheduling class (not arrival
+                        # order) decides who joins the in-flight batch
+                        ordered = (self.policy.order(list(self._joiners))
+                                   if self.policy is not None
+                                   else list(self._joiners))
+                        for joiner in ordered:
+                            if not free:
+                                break
+                            self._joiners.remove(joiner)
+                            joiner["slot"] = free.pop(0)
+                            self._active[joiner["slot"]] = joiner
+                    packing = [a for a in self._active
+                               if a is not None and not a.get("packed")]
+                    if not any(self._active):
+                        # idle: engine exits; next request restarts it
+                        self._engine_running = False
+                        self._lock.notify_all()
+                        return
+                if self._carry is None:
+                    self._carry = self._init_carry()
+                raw = [a for a in packing if a.get("carry") is None]
+                carried = [a for a in packing if a.get("carry") is not None]
+                group_carry = None
+                if raw:
+                    try:
+                        group_carry = self._prefill_group(raw)
+                        with self._lock:
+                            self.prefill_groups += 1
+                            self.rows_group_prefilled += len(raw)
+                    except Exception as e:  # noqa: BLE001
+                        # a group-prefill failure (fresh-bucket compile
+                        # OOM, transient device error) errors ONLY the
+                        # raw joiners — in-flight decode and carried
+                        # joiners keep running, matching the isolation
+                        # request-thread prefill used to provide
+                        log.error("group prefill failed: %s", e)
+                        with self._lock:
+                            for j in raw:
+                                j["error"], j["done"] = e, True
+                                self._active[j["slot"]] = None
+                            self._lock.notify_all()
+                        raw = []
+                for src, joiner in enumerate(raw):
+                    self._carry = self._pack(self._carry, group_carry, src,
+                                             joiner["slot"])
+                    joiner["packed"] = True
+                group_carry = None  # free the group cache
+                for joiner in carried:
+                    self._carry = self._pack(self._carry, joiner["carry"],
+                                             0, joiner["slot"])
+                    joiner["carry"] = None  # free the 1-row cache
+                    joiner["packed"] = True
+                # ---- pipelined dispatch: keep up to pipeline_depth
+                # segments in flight; once the frontier is full, each
+                # dispatch is followed by collecting the OLDEST segment,
+                # so the fetch overlaps the next segment's compute ----
+                cause = None
+                while True:
+                    with self._lock:
+                        live = [(slot, e)
+                                for slot, e in enumerate(self._active)
+                                if e is not None]
+                        if not any(not e["done"]
+                                   and e["disp"] < e["n"]
+                                   for _, e in live):
+                            # every live row has its full output
+                            # dispatched — drain to observe the tails
+                            cause = "complete"
+                            break
+                        if self._joiners and (
+                                len(live) < self.slots
+                                or any(e["done"] for _, e in live)):
+                            # a joiner can take (or is about to take) a
+                            # slot: stop dispatching so the bounded
+                            # drain below (at most pipeline_depth - 1
+                            # segments) reaches the packing barrier
+                            cause = "joiner"
+                            break
+                        t_host = np.zeros((self.slots,), np.float32)
+                        k_host = np.zeros((self.slots,), np.int32)
+                        p_host = np.ones((self.slots,), np.float32)
+                        positions = []  # live rows' dispatch positions
+                        need_lp = False
+                        for slot, e in live:
+                            if e["done"]:
+                                # finished mid-pipeline: still stepped
+                                # by the device (garbage) but its knobs,
+                                # window need and fetch wants are dead
+                                continue
+                            t_host[slot] = e["temperature"] or 0.0
+                            k_host[slot] = e["top_k"] or 0
+                            p_host[slot] = (1.0 if e["top_p"] is None
+                                            else e["top_p"])
+                            # the DEVICE-side position: tokens already
+                            # dispatched, not yet necessarily fetched
+                            positions.append(e["pos0"] + e["disp"])
+                            need_lp = need_lp or e["want_lp"]
+                            e["disp"] += self.segment
+                    # window bucketing: the segment's furthest write
+                    # lands at max(pos) + segment - 1, so a pow-2 window
+                    # >= max(pos) + segment keeps every live row's
+                    # reads/writes in bounds and the output bitwise the
+                    # full-window program's. Retired/finished slots'
+                    # garbage rows may hold larger stale positions;
+                    # their out-of-window scatters drop harmlessly
+                    # (nothing reads them).
+                    window = self.cache_len
+                    if self.window_bucketing and positions:
+                        needed = max(positions) + self.segment
+                        window = min(_next_bucket(needed, 16),
+                                     self.cache_len)
+                    if window < self.cache_len:
+                        seg = server._windowed_seg_fn(
+                            self.slots, self.cache_len, window,
+                            self.segment)
+                    else:
+                        seg = seg_full
+                    t_disp = time.monotonic()
+                    with server._mesh_ctx():
+                        (toks, lps), self._carry = seg(
+                            server.params, jnp.asarray(t_host),
+                            jnp.asarray(k_host), jnp.asarray(p_host),
+                            *self._carry, eos_op)
+                    # attended = per-row sum of positions each step's
+                    # attention actually covered (pos + 1 keys at write
+                    # index pos)
+                    inflight.append({
+                        "toks": toks, "lps": lps, "need_lp": need_lp,
+                        "rows": live, "window": window,
+                        "t_dispatch": t_disp,
+                        "attended": sum(self.segment * p + self.segment
+                                        * (self.segment + 1) // 2
+                                        for p in positions),
+                        "window_read": (len(positions) * self.segment
+                                        * window),
+                        "full_window": (len(positions) * self.segment
+                                        * self.cache_len)})
+                    pstats.record_dispatch(len(inflight))
+                    if len(inflight) >= self.pipeline_depth:
+                        collect_one()
+                # ---- drain: collect everything behind the frontier so
+                # the barrier above sees host-truth slots and a
+                # host-materialized carry ----
+                if inflight:
+                    pstats.record_drain(cause)
+                    while inflight:
+                        collect_one()
+        finally:
+            pstats.record_wall(time.monotonic() - ep_t0)
 
     def _prefill_prefix_row(self, prefix_tokens, row, s: int, entry: dict,
                             pentry=None):
@@ -518,6 +680,14 @@ class ContinuousBatcher:
                  "seed": seed, "toks": [], "lps": [],
                  "want_lp": return_logprobs,
                  "done": False, "error": None, "slot": None, "packed": False,
+                 # tokens DISPATCHED for this row (>= len(toks) while
+                 # segments are in flight) — the device-side decode
+                 # position the pipelined loop windows and quotas by
+                 "disp": 0,
+                 # absolute index of the row's first eos token, recorded
+                 # by the collector's incremental block scan; None until
+                 # (unless) one appears
+                 "eos_at": None,
                  # decode position at join time (prompt end; prefix rows
                  # include the cached prefix) — the window bucketing's
                  # host-side view of how far this row's cache reaches
@@ -604,9 +774,15 @@ class ContinuousBatcher:
             raise entry["error"]
         toks, lps = entry["toks"], entry["lps"]
         # solo-parity post-processing: truncate at the row's own eos and
-        # pad with the eos filler, exactly like the fused path's latch
-        if eos_id is not None and eos_id in toks:
-            cut = toks.index(eos_id) + 1
+        # pad with the eos filler, exactly like the fused path's latch.
+        # The collector recorded the first-hit index (entry["eos_at"])
+        # while scanning each newly appended block, so no rescan here;
+        # an eos landing at or past max_new_tokens is out of the
+        # delivered window and latches nothing.
+        eos_at = entry["eos_at"]
+        if eos_id is not None and eos_at is not None \
+                and eos_at < max_new_tokens:
+            cut = eos_at + 1
             toks = toks[:cut] + [eos_id] * (max_new_tokens - cut)
             lps = lps[:cut] + [0.0] * (max_new_tokens - cut)
         out = np.asarray([toks[:max_new_tokens]], np.int32)
@@ -681,6 +857,8 @@ class ContinuousBatcher:
             return {"mode": "continuous", "slots": self.slots,
                     "segment": self.segment, "cache_len": self.cache_len,
                     "window_bucketing": self.window_bucketing,
+                    "pipeline_depth": self.pipeline_depth,
+                    "pipeline": self.pipeline_stats.report(),
                     "decode_window": self.window_stats.report(),
                     "segments_run": self.segments_run,
                     "rows_in_segments": self.rows_in_segments,

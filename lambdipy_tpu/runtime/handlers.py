@@ -414,13 +414,24 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             wb = extra.get(
                 "batch_window_bucketing",
                 _os.environ.get("LAMBDIPY_WINDOW_BUCKETING", "1"))
+            # pipelined dispatch/collect: segments kept in flight on the
+            # device before the host fetches the oldest. 1 restores the
+            # synchronous loop; the default 2 overlaps device compute
+            # with the per-segment fetch RTT + host bookkeeping. Same
+            # precedence as the window-bucketing knob: an explicit
+            # bundle extra wins over the environment (set by
+            # `lambdipy serve --pipeline-depth`).
+            pd = extra.get(
+                "pipeline_depth",
+                _os.environ.get("LAMBDIPY_PIPELINE_DEPTH", "2"))
             batcher = continuous = ContinuousBatcher(
                 server, slots=int(extra.get("batch_max", 8)),
                 segment=int(extra.get("batch_segment", 16)),
                 cache_len=int(bcl) if bcl else None,
                 policy=sched_policy,
                 window_bucketing=str(wb).lower() not in ("0", "false",
-                                                         "off"))
+                                                         "off"),
+                pipeline_depth=int(pd))
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 

@@ -10,6 +10,7 @@ automatic prefix KV cache (runtime/prefixstore.py) publishes under
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 
@@ -107,6 +108,97 @@ class DecodeWindowStats:
                 "segments": self.segments,
                 "buckets": {str(w): n
                             for w, n in sorted(self.buckets.items())},
+            }
+
+
+@dataclass
+class PipelineStats:
+    """Counters for the continuous engine's pipelined dispatch/collect
+    loop (the ``batching.pipeline`` block on ``/metrics``). ``in_flight``
+    histograms the pipeline depth at each dispatch (how many segments
+    were queued on the device, this one included); ``drains`` counts the
+    barrier causes (``joiner`` = a pending joiner forced a bounded drain
+    so packing sees host-truth slots, ``complete`` = every live row
+    reached its dispatch quota). ``wasted_overdecode_tokens`` are tokens
+    fetched for rows that had already finished (EOS observed behind the
+    dispatch frontier) and were discarded host-side. ``overlap_ratio`` =
+    device-busy / wall: device-busy is the union of each segment's
+    [dispatch, fetch-complete] interval, so 1.0 means the device always
+    had a segment in flight while the host fetched and booked results —
+    the overlap the pipeline exists to create."""
+
+    depth: int = 1             # configured pipeline_depth
+    segments: int = 0          # segments collected (host-fetched)
+    dispatches: int = 0        # segments dispatched
+    wasted_tokens: int = 0     # over-decoded tokens discarded host-side
+    inflight: dict = field(default_factory=dict)  # depth -> dispatches
+    drains: dict = field(default_factory=dict)    # cause -> count
+    device_busy_s: float = 0.0
+    fetch_block_s: float = 0.0  # host wall spent blocked in device_get
+    wall_s: float = 0.0         # engine-busy wall (idle time excluded)
+    _cover_end: float = field(default=0.0, repr=False)
+    # monotonic start of the episode currently running, or None when the
+    # engine is idle — report() folds the open episode into wall so a
+    # mid-episode scrape never divides device_busy_s by a stale wall
+    _ep_t0: float | None = field(default=None, repr=False)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_dispatch(self, inflight_depth: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.inflight[int(inflight_depth)] = \
+                self.inflight.get(int(inflight_depth), 0) + 1
+
+    def record_collect(self, dispatch_t: float, ready_t: float, *,
+                       fetch_s: float, wasted: int) -> None:
+        with self._lock:
+            self.segments += 1
+            self.wasted_tokens += int(wasted)
+            self.fetch_block_s += max(0.0, fetch_s)
+            # union of [dispatch, compute-ready] intervals (ready is
+            # when block_until_ready returned — BEFORE the fetch RTT,
+            # which the device spends idle unless another segment is
+            # queued behind it), accumulated incrementally: both
+            # endpoints are monotone across segments, so the uncovered
+            # part of this interval starts at the later of its own
+            # dispatch and the previous cover's end
+            self.device_busy_s += max(
+                0.0, ready_t - max(dispatch_t, self._cover_end))
+            self._cover_end = max(self._cover_end, ready_t)
+
+    def record_drain(self, cause: str) -> None:
+        with self._lock:
+            self.drains[cause] = self.drains.get(cause, 0) + 1
+
+    def begin_episode(self, t: float) -> None:
+        """Mark an engine episode open at monotonic time ``t``."""
+        with self._lock:
+            self._ep_t0 = t
+
+    def record_wall(self, seconds: float) -> None:
+        """Close the open episode, folding its wall into ``wall_s``."""
+        with self._lock:
+            self.wall_s += max(0.0, seconds)
+            self._ep_t0 = None
+
+    def report(self) -> dict:
+        with self._lock:
+            wall = self.wall_s
+            if self._ep_t0 is not None:
+                wall += max(0.0, time.monotonic() - self._ep_t0)
+            return {
+                "depth": self.depth,
+                "segments": self.segments,
+                "dispatches": self.dispatches,
+                "wasted_overdecode_tokens": self.wasted_tokens,
+                "in_flight": {str(d): n
+                              for d, n in sorted(self.inflight.items())},
+                "drains": dict(self.drains),
+                "device_busy_s": round(self.device_busy_s, 4),
+                "fetch_block_s": round(self.fetch_block_s, 4),
+                "wall_s": round(wall, 4),
+                "overlap_ratio": (round(self.device_busy_s / wall, 4)
+                                  if wall else 0.0),
             }
 
 

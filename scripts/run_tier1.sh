@@ -13,10 +13,13 @@
 # Phase 3 is a quick forced-CPU bench.py smoke (tiny model) so a bench
 # orchestration regression turns tier-1 red, not measurement day.
 #
-# Phase 4 smokes the decode-window sweep; phase 5 the FLEET (2 CPU
-# replicas behind the affinity router, one SIGKILLed mid-traffic —
-# zero lost requests, ejection, supervisor respawn, re-admission,
-# rolling restart — the slow tests in tests/test_fleet.py).
+# Phase 4 smokes the decode-window sweep; phase 5 the pipelined-engine
+# sweep (bitwise parity across pipeline depths + depth-2 tok/s beating
+# depth-1 under a synthetic fetch RTT — bench.py --pipeline exits
+# nonzero on either regression); phase 6 the FLEET (2 CPU replicas
+# behind the affinity router, one SIGKILLed mid-traffic — zero lost
+# requests, ejection, supervisor respawn, re-admission, rolling
+# restart — the slow tests in tests/test_fleet.py).
 #
 # Every phase prints its wall-clock so the budget breakdown is visible
 # in the log (ROADMAP open item: phase 2 runs close to its 870 s cap).
@@ -61,19 +64,31 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
 fi
 phase_end "phase 4"
 
-# Phase 5: fleet smoke (~3-4 min CPU) — boots 2 supervised CPU replicas
+# Phase 5: pipelined-engine smoke — the sweep itself asserts bitwise
+# parity between pipeline depths and that depth-2 throughput stays
+# above depth-1 at the synthetic-RTT points (20/66 ms), so either
+# regression turns tier-1 red here.
+phase_begin "phase 5: pipeline bench smoke"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --pipeline; then
+    echo "FATAL: bench.py --pipeline smoke failed" >&2
+    exit 1
+fi
+phase_end "phase 5"
+
+# Phase 6: fleet smoke (~3-4 min CPU) — boots 2 supervised CPU replicas
 # behind the affinity router, SIGKILLs one worker mid-traffic and
 # asserts zero failed requests, ejection within a probe interval,
 # re-admission after the supervisor respawn (same URL), then a rolling
 # restart over the live floor; plus router-vs-direct bitwise parity,
 # the live-server readiness split, and the shared-prefix
 # affinity-concentration check (all the `slow` tests in test_fleet.py).
-phase_begin "phase 5: fleet smoke (tests/test_fleet.py -m slow)"
+phase_begin "phase 6: fleet smoke (tests/test_fleet.py -m slow)"
 if ! timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_fleet.py -q -m slow \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "FATAL: fleet smoke failed" >&2
     exit 1
 fi
-phase_end "phase 5"
+phase_end "phase 6"
 exit 0

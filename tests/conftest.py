@@ -46,6 +46,20 @@ def tmp_registry(tmp_path):
 
 
 @pytest.fixture(scope="session")
+def tiny_server():
+    """One shared llama-tiny LlamaServer for the engine test modules:
+    its compiled-program cache is the expensive part, and the continuous
+    and pipelined-engine suites exercise the same program families —
+    building per-module would recompile them all. Tests that mutate
+    server state (prefix registry, custom caps) build their own."""
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    return adapter.make_server(params)
+
+
+@pytest.fixture(scope="session")
 def cpu_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"

@@ -3,6 +3,7 @@ diagnosis, fallback, and compile-cache persistence across attempts
 (VERDICT r2 weak #4). All runs forced onto CPU with the tiny model so no
 real chip is touched."""
 
+import importlib.util
 import json
 import os
 import subprocess
@@ -12,6 +13,13 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
+
+
+def _bench_module():
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _run_bench(tmp_path, extra_env, timeout=900):
@@ -52,6 +60,61 @@ def test_bench_wedge_is_diagnosed_and_falls_back(tmp_path):
     assert "wedge" in out["stages"]["device.devices"]
     assert out["stages"]["cpu.model"] == "ok"
     assert out["value"] > 0
+
+
+def test_wedge_verdict_cache_roundtrip(tmp_path, monkeypatch):
+    """The device-wedge verdict persists across bench invocations (so
+    repeated runs against a dead transport fail fast instead of
+    re-burning the probe timeout), honors its TTL, and is disabled by
+    TTL=0."""
+    bench = _bench_module()
+    monkeypatch.setenv("LAMBDIPY_BENCH_CACHE", str(tmp_path / "cache"))
+    assert bench._read_cached_wedge() is None  # no verdict yet
+    bench._write_wedge_verdict("devices: wedge (timeout after 60s)")
+    verdict = bench._read_cached_wedge()
+    assert verdict is not None and "wedge" in verdict
+    assert "cached verdict" in verdict
+    monkeypatch.setenv("LAMBDIPY_BENCH_WEDGE_TTL", "0")
+    assert bench._read_cached_wedge() is None  # TTL=0 disables the cache
+    monkeypatch.setenv("LAMBDIPY_BENCH_WEDGE_TTL", "600")
+    assert bench._read_cached_wedge() is not None
+
+
+def test_device_probe_timeout_env(monkeypatch):
+    """The devices stage gets its own SHORT leash: 60 s default (the
+    240 s probe default burned 4 minutes per bench invocation on a
+    wedged transport — BENCH_r04/r05), LAMBDIPY_DEVICE_PROBE_TIMEOUT_S
+    overrides it, and the generic probe timeout still applies as the
+    fallback (and to the other probe stages)."""
+    bench = _bench_module()
+    for var in ("LAMBDIPY_DEVICE_PROBE_TIMEOUT_S",
+                "LAMBDIPY_BENCH_PROBE_TIMEOUT"):
+        monkeypatch.delenv(var, raising=False)
+    assert bench._stage_timeout("devices", "device") == 60.0
+    assert bench._stage_timeout("matmul", "device") == 240.0
+    monkeypatch.setenv("LAMBDIPY_BENCH_PROBE_TIMEOUT", "20")
+    assert bench._stage_timeout("devices", "device") == 20.0
+    monkeypatch.setenv("LAMBDIPY_DEVICE_PROBE_TIMEOUT_S", "5")
+    assert bench._stage_timeout("devices", "device") == 5.0
+    assert bench._stage_timeout("matmul", "device") == 20.0
+
+
+@pytest.mark.slow
+def test_bench_cached_wedge_skips_device_attempt(tmp_path):
+    """Second invocation against the same (still-wedged) transport must
+    skip the device attempt via the cached verdict — no probe-timeout
+    burn — and still produce the CPU fallback metric."""
+    env = {"LAMBDIPY_BENCH_WEDGE": "device.devices",
+           "LAMBDIPY_BENCH_PROBE_TIMEOUT": "15"}
+    rc1, out1 = _run_bench(tmp_path, env)
+    assert rc1 == 0
+    assert "wedge" in out1["stages"]["device.devices"]
+    assert "cached" not in out1["stages"]["device.devices"]
+    rc2, out2 = _run_bench(tmp_path, env)
+    assert rc2 == 0
+    assert "cached verdict" in out2["stages"]["device.devices"]
+    assert out2["stages"]["cpu.model"] == "ok"
+    assert out2["value"] > 0
 
 
 @pytest.mark.slow

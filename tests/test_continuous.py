@@ -10,14 +10,8 @@ import pytest
 
 from lambdipy_tpu.runtime.continuous import ContinuousBatcher
 
-
-@pytest.fixture(scope="module")
-def tiny_server():
-    from lambdipy_tpu.models import registry
-
-    adapter = registry.get("llama-tiny").build()
-    params = adapter.init_params(seed=0)
-    return adapter.make_server(params)
+# tiny_server: the session-scoped shared LlamaServer from conftest.py
+# (one compiled-program cache across the continuous-engine modules)
 
 
 def test_staggered_concurrent_requests_match_solo(tiny_server):
@@ -240,17 +234,23 @@ def test_stream_rides_the_engine(tiny_server):
     assert stats["rows_in_segments"] > stats["segments_run"], stats
 
 
-def test_stream_eos_and_logprobs_through_engine(tiny_server):
-    """Engine streaming latches eos with fused-path parity and carries
-    logprobs."""
-    cb = ContinuousBatcher(tiny_server, slots=2, segment=4)
-    fused = tiny_server.generate([1, 2, 3], max_new_tokens=11)
+def assert_stream_eos_latch(server, cb):
+    """Shared scenario (also run at depth 3 by the pipelined-engine
+    module): streaming latches eos with fused-path parity."""
+    fused = server.generate([1, 2, 3], max_new_tokens=11)
     eos = int(fused[0, 1])
-    ref = tiny_server.generate([1, 2, 3], max_new_tokens=11, eos_id=eos)
+    ref = server.generate([1, 2, 3], max_new_tokens=11, eos_id=eos)
     got = np.concatenate(list(cb.generate_stream(
         [1, 2, 3], max_new_tokens=11, eos_id=eos)), axis=1)
     assert got.shape[1] < 11  # stopped at a segment boundary
     np.testing.assert_array_equal(got, ref[:, :got.shape[1]])
+
+
+def test_stream_eos_and_logprobs_through_engine(tiny_server):
+    """Engine streaming latches eos with fused-path parity and carries
+    logprobs."""
+    cb = ContinuousBatcher(tiny_server, slots=2, segment=4)
+    assert_stream_eos_latch(tiny_server, cb)
     ft, fl = tiny_server.generate([5, 6], max_new_tokens=8,
                                   return_logprobs=True)
     pairs = list(cb.generate_stream([5, 6], max_new_tokens=8,
@@ -262,14 +262,13 @@ def test_stream_eos_and_logprobs_through_engine(tiny_server):
         rtol=1e-5, atol=1e-6)
 
 
-def test_prefix_rows_join_the_engine(tiny_server):
-    """A prefix-cached request packs its continuation carry into an
-    engine slot (VERDICT r5 #3c): output equals the full-prompt fused
-    output, streamed and not, while sharing segments with other
-    traffic; a cache-capped engine falls back solo instead."""
-    cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
+def assert_prefix_join_parity(server, cb):
+    """Shared scenario (also run at depth 3 by the pipelined-engine
+    module): a prefix-cached row's engine output equals the full-prompt
+    fused output, streamed and not, while sharing segments with other
+    traffic."""
     prefix = list(range(1, 20))
-    full = tiny_server.generate(prefix + [4, 5], max_new_tokens=8)
+    full = server.generate(prefix + [4, 5], max_new_tokens=8)
     with ThreadPoolExecutor(max_workers=2) as ex:
         f_other = ex.submit(cb.generate, [9, 8, 7], max_new_tokens=8)
         via = cb.generate([4, 5], max_new_tokens=8, prefix=prefix)
@@ -278,6 +277,17 @@ def test_prefix_rows_join_the_engine(tiny_server):
     st = np.concatenate(list(cb.generate_stream(
         [4, 5], max_new_tokens=8, prefix=prefix)), axis=1)
     np.testing.assert_array_equal(st, full)
+
+
+def test_prefix_rows_join_the_engine(tiny_server):
+    """A prefix-cached request packs its continuation carry into an
+    engine slot (VERDICT r5 #3c): output equals the full-prompt fused
+    output, streamed and not, while sharing segments with other
+    traffic; a cache-capped engine falls back solo instead."""
+    cb = ContinuousBatcher(tiny_server, slots=4, segment=4)
+    assert_prefix_join_parity(tiny_server, cb)
+    prefix = list(range(1, 20))
+    full = tiny_server.generate(prefix + [4, 5], max_new_tokens=8)
     capped = ContinuousBatcher(tiny_server, slots=2, segment=4,
                                cache_len=32)
     np.testing.assert_array_equal(

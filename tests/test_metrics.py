@@ -6,7 +6,7 @@ decode-window (length-aware decode) counter block."""
 import threading
 
 from lambdipy_tpu.runtime.metrics import (DecodeWindowStats, LatencyStats,
-                                          PrefixCacheStats)
+                                          PipelineStats, PrefixCacheStats)
 
 
 def test_empty_reservoir_reports_none():
@@ -130,6 +130,94 @@ def test_decode_window_stats_concurrent():
     assert rep["segments"] == 800
     assert rep["window_tokens"] == 800 * 32
     assert rep["savings_ratio"] == 0.5
+
+
+def test_pipeline_stats_empty_report():
+    st = PipelineStats(depth=2)
+    assert st.report() == {"depth": 2, "segments": 0, "dispatches": 0,
+                           "wasted_overdecode_tokens": 0, "in_flight": {},
+                           "drains": {}, "device_busy_s": 0.0,
+                           "fetch_block_s": 0.0, "wall_s": 0.0,
+                           "overlap_ratio": 0.0}
+
+
+def test_pipeline_stats_counters_and_overlap_union():
+    """The ``batching.pipeline`` block: in-flight histogram, drain
+    causes, wasted over-decode tokens, and the overlap ratio — device
+    busy is the UNION of per-segment [dispatch, compute-ready]
+    intervals, so two overlapping in-flight segments count their shared
+    window once."""
+    st = PipelineStats(depth=2)
+    st.record_dispatch(1)
+    st.record_dispatch(2)
+    st.record_dispatch(2)
+    # seg A: dispatched t=0, ready t=1. seg B: dispatched t=0.5 (while A
+    # in flight), ready t=2 -> union busy = [0, 2] = 2.0, not 2.5
+    st.record_collect(0.0, 1.0, fetch_s=0.2, wasted=0)
+    st.record_collect(0.5, 2.0, fetch_s=0.3, wasted=4)
+    st.record_drain("joiner")
+    st.record_drain("complete")
+    st.record_drain("complete")
+    st.record_wall(4.0)
+    rep = st.report()
+    assert rep["dispatches"] == 3 and rep["segments"] == 2
+    assert rep["in_flight"] == {"1": 1, "2": 2}
+    assert rep["drains"] == {"joiner": 1, "complete": 2}
+    assert rep["wasted_overdecode_tokens"] == 4
+    assert rep["device_busy_s"] == 2.0
+    assert rep["fetch_block_s"] == 0.5
+    assert rep["wall_s"] == 4.0
+    assert rep["overlap_ratio"] == 0.5
+
+
+def test_pipeline_stats_disjoint_intervals_sum():
+    """Non-overlapping segments (the depth-1 synchronous loop) sum their
+    individual compute windows — the ratio then reads the device's real
+    duty cycle."""
+    st = PipelineStats(depth=1)
+    st.record_collect(0.0, 1.0, fetch_s=0.5, wasted=0)
+    st.record_collect(2.0, 2.5, fetch_s=0.5, wasted=0)  # idle gap 1..2
+    st.record_wall(2.5)
+    rep = st.report()
+    assert rep["device_busy_s"] == 1.5
+    assert rep["overlap_ratio"] == 0.6
+
+
+def test_pipeline_stats_open_episode_wall():
+    """A /metrics scrape mid-episode folds the OPEN episode into wall:
+    under sustained traffic the engine never goes idle, so overlap_ratio
+    would otherwise read 0.0 forever (first episode) or divide by only
+    the completed episodes' wall (> 1.0 ratios later)."""
+    import time
+
+    st = PipelineStats(depth=2)
+    st.begin_episode(time.monotonic() - 2.0)
+    st.record_collect(0.0, 1.0, fetch_s=0.1, wasted=0)
+    rep = st.report()
+    assert rep["wall_s"] >= 2.0
+    assert 0.0 < rep["overlap_ratio"] <= 1.0
+    st.record_wall(2.0)  # closes the episode
+    assert st.report()["wall_s"] == 2.0
+
+
+def test_pipeline_stats_concurrent():
+    st = PipelineStats()
+
+    def write():
+        for i in range(200):
+            st.record_dispatch(1 + i % 2)
+            st.record_collect(float(i), float(i) + 0.5, fetch_s=0.1,
+                              wasted=1)
+
+    threads = [threading.Thread(target=write) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = st.report()
+    assert rep["dispatches"] == 800 and rep["segments"] == 800
+    assert rep["wasted_overdecode_tokens"] == 800
+    assert rep["in_flight"] == {"1": 400, "2": 400}
 
 
 def test_prefix_cache_stats_counters():
