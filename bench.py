@@ -783,6 +783,191 @@ def pipeline_record(*, depths=(1, 2), rtts_ms=(0.0, 20.0, 66.0),
     }
 
 
+def chaos_record(*, kinds=("exception", "delay", "hang"),
+                 n_new: int = 16, segment: int = 4,
+                 watchdog_s: float = 1.0, max_replays: int = 1,
+                 extra: dict | None = None) -> dict:
+    """Deterministic chaos matrix (CPU-runnable): every fault site x
+    {exception, delay, hang} injected into a live continuous engine via
+    runtime/faults.py, asserting the fault-isolation contract end to
+    end — no waiter outlives its bound, zero requests are silently lost
+    (each returns a result, a transparently replayed result, or an
+    explicit error), and the engine serves a bitwise-clean request
+    afterwards. Also asserts the REPLAY PARITY claim: a seeded-sampled
+    request whose first attempt dies at an injected fault returns a
+    bitwise-identical completion to the fault-free run, plus one
+    permanent-hang case proving a wedged engine errors its waiters
+    within the watchdog bound instead of hanging them."""
+    import threading as _threading
+
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+    from lambdipy_tpu.runtime.faults import SITES, FaultPlan
+
+    dims = {"vocab_size": 2048, "hidden": 128, "layers": 2, "heads": 4,
+            "kv_heads": 2, "mlp": 256, "max_len": 128}
+    dims.update(extra or {})
+    adapter = registry.get("llama3-8b").build(dtype="float32", extra=dims)
+    params = jax.device_put(adapter.init_params(seed=0))
+    server = adapter.make_server(params)
+
+    # one greedy + one seeded-sampled row: replay parity must hold for
+    # both (the sampled row is the stronger claim — its PRNG chain must
+    # restart bitwise); the prefix row exercises the prefix_assemble site
+    reqs = [
+        {"row": [1, 2, 3, 4], "kw": {}},
+        {"row": [9, 8, 7], "kw": dict(temperature=0.8, seed=7)},
+    ]
+    prefix = list(range(1, 20))
+    solo = [server.generate(r["row"], max_new_tokens=n_new, **r["kw"])
+            for r in reqs]
+    solo_pfx = server.generate(prefix + [4, 5], max_new_tokens=n_new)
+
+    # warm every engine program this matrix can dispatch (group prefill
+    # at joiner counts 1-3, pack, segment windows, prefix continuation)
+    # through a fault-free engine first: the watchdog cannot tell a
+    # first-use XLA compile from a wedge, and the whole point of a 1 s
+    # chaos watchdog is bounding waits that are normally milliseconds
+    from concurrent.futures import ThreadPoolExecutor
+
+    warm = ContinuousBatcher(server, slots=4, segment=segment)
+    with ThreadPoolExecutor(max_workers=3) as ex:
+        futs = [ex.submit(warm.generate, r["row"], max_new_tokens=n_new,
+                          **r["kw"]) for r in reqs]
+        futs.append(ex.submit(warm.generate, [4, 5],
+                              max_new_tokens=n_new, prefix=prefix))
+        for f in futs:
+            f.result()
+    for r in reqs:  # solo joins compile the 1-row group-prefill family
+        warm.generate(r["row"], max_new_tokens=n_new, **r["kw"])
+
+    def run_case(site: str, kind: str, *, spec: str, permanent: bool):
+        plan = FaultPlan.from_spec(spec)
+        engine = ContinuousBatcher(server, slots=4, segment=segment,
+                                   faults=plan, watchdog_s=watchdog_s,
+                                   max_replays=max_replays)
+        results: dict = {}
+
+        def one(i, row, kw, pfx=None):
+            try:
+                results[i] = engine.generate(
+                    row, max_new_tokens=n_new, prefix=pfx, **kw)
+            except Exception as e:  # noqa: BLE001 — explicit error = ok
+                results[i] = e
+
+        workers = [
+            _threading.Thread(target=one, args=(i, r["row"], r["kw"]),
+                              daemon=True)
+            for i, r in enumerate(reqs)]
+        if site == "prefix_assemble":
+            workers.append(_threading.Thread(
+                target=one, args=(len(reqs), [4, 5], {}, prefix),
+                daemon=True))
+        for w in workers:
+            w.start()
+        # the waiter bound: injected hangs must resolve via the watchdog
+        # (trip + replay or error), never by this deadline
+        deadline = time.monotonic() + max(30.0, 8 * watchdog_s)
+        for w in workers:
+            w.join(timeout=max(0.0, deadline - time.monotonic()))
+        hung = [i for i, w in enumerate(workers) if w.is_alive()]
+        if hung:
+            raise AssertionError(
+                f"chaos {site}:{kind}: waiter(s) {hung} still blocked "
+                f"past the bound — the watchdog failed its one job")
+        ok = errors = 0
+        refs = solo + [solo_pfx]
+        for i, w in enumerate(workers):
+            out = results.get(i)
+            if isinstance(out, Exception):
+                errors += 1
+            elif out is not None and np.array_equal(out, refs[i]):
+                ok += 1
+            else:
+                raise AssertionError(
+                    f"chaos {site}:{kind}: request {i} returned WRONG "
+                    f"tokens — silent corruption, worse than an error")
+        if kind == "delay" and errors:
+            raise AssertionError(
+                f"chaos {site}:{kind}: a pure delay errored {errors} "
+                f"request(s) — delays must only slow, never fail")
+        plan.release()
+        faults = engine.stats()["faults"]
+        if not permanent:
+            # the engine must serve again, bitwise, on the SAME batcher
+            again = engine.generate(reqs[0]["row"], max_new_tokens=n_new)
+            if not np.array_equal(again, solo[0]):
+                raise AssertionError(
+                    f"chaos {site}:{kind}: post-fault output diverged")
+            if engine.wedged:
+                raise AssertionError(
+                    f"chaos {site}:{kind}: engine still wedged after a "
+                    f"clean serve")
+        elif errors == 0:
+            raise AssertionError(
+                f"chaos {site}:{kind} (permanent): every waiter "
+                f"'succeeded' against a permanently hung site")
+        return {"site": site, "kind": kind, "spec": spec, "ok": ok,
+                "errors": errors, "faults": faults}
+
+    cases = []
+    for site in SITES:
+        for kind in kinds:
+            if kind == "delay":
+                spec = f"{site}:delay@ms=120,n=2"
+            elif kind == "exception":
+                spec = f"{site}:exception@seg=1"
+            else:
+                # bounded hang: the watchdog trips, the replay lands on
+                # the recovered site — the permanent variant runs below
+                spec = f"{site}:hang@seg=1,n=1"
+            cases.append(run_case(site, kind, spec=spec, permanent=False))
+    # the permanent wedge: every fetch hangs forever; waiters must get
+    # explicit errors within the watchdog bound and the engine must
+    # report wedged on its fault surface
+    perm = run_case("segment_fetch", "hang",
+                    spec="segment_fetch:hang", permanent=True)
+    if not perm["faults"]["wedged"]:
+        raise AssertionError(
+            "permanent segment_fetch hang did not wedge the engine")
+    cases.append({**perm, "kind": "hang_permanent"})
+    replayed = sum(c["faults"]["replays"]["succeeded"] for c in cases)
+    if replayed == 0:
+        raise AssertionError("no chaos case exercised a successful "
+                             "replay — the matrix is vacuous")
+    return {
+        "mode": "chaos",
+        "platform": jax.devices()[0].platform,
+        "watchdog_s": watchdog_s,
+        "max_replays": max_replays,
+        "n_new": n_new,
+        "cases": cases,
+        "replays_succeeded": replayed,
+        "passed": True,
+    }
+
+
+def _chaos_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chaos", action="store_true")
+    ap.add_argument("--watchdog-s", type=float, default=1.0)
+    ap.add_argument("--max-replays", type=int, default=1)
+    ap.add_argument("--n-new", type=int, default=16)
+    ap.add_argument("--segment", type=int, default=4)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(chaos_record(
+        watchdog_s=args.watchdog_s, max_replays=args.max_replays,
+        n_new=args.n_new, segment=args.segment)))
+    return 0
+
+
 def _pipeline_main() -> int:
     import argparse
 
@@ -945,6 +1130,11 @@ def main() -> int:
         # pipeline depths + depth-2 tok/s beating depth-1 under a
         # synthetic per-fetch transport RTT
         return _pipeline_main()
+    if "--chaos" in sys.argv:
+        # CPU-runnable chaos matrix: every fault site x kind injected
+        # into a live engine — watchdog bounds, replay parity, ladder
+        # and wedge behavior asserted (exits nonzero on any violation)
+        return _chaos_main()
     if "--fleet" in sys.argv:
         # CPU-runnable fleet sweep: N replicas behind the affinity
         # router vs one direct — parity + affinity/prefix hit rates

@@ -465,9 +465,16 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "overlaps device compute with the fetch RTT + host "
                    "bookkeeping (default: bundle pipeline_depth, "
                    "else 2)")
+@click.option("--engine-watchdog", type=float, default=None,
+              help="seconds after which a hung device-side engine wait "
+                   "(dispatch / segment fetch / group prefill) marks "
+                   "the engine wedged, aborts its waiters and flips "
+                   "/healthz to wedged (continuous engine; 0 disables "
+                   "— size it ABOVE the transport's worst-case compile "
+                   "wall; default: bundle engine_watchdog_s, else off)")
 def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
-              prefix_block, pipeline_depth):
+              prefix_block, pipeline_depth, engine_watchdog):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
@@ -480,6 +487,8 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_PREFIX_BLOCK"] = str(prefix_block)
     if pipeline_depth is not None:
         os.environ["LAMBDIPY_PIPELINE_DEPTH"] = str(pipeline_depth)
+    if engine_watchdog is not None:
+        os.environ["LAMBDIPY_ENGINE_WATCHDOG_S"] = str(engine_watchdog)
     # BundleServer resolves the effective policy (bundle extra <
     # LAMBDIPY_SCHED_POLICY env < these flags) and bridges it to the
     # handler's batch formation itself — no env plumbing needed here
@@ -529,9 +538,14 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
                    "or a fixed threshold in ms")
 @click.option("--timeout", type=float, default=300.0, show_default=True,
               help="per-replica deploy ready timeout (seconds)")
+@click.option("--engine-watchdog", type=float, default=None,
+              help="per-replica engine watchdog in seconds (see "
+                   "`lambdipy serve --engine-watchdog`): a replica "
+                   "whose device wait hangs flips its /healthz to "
+                   "wedged and the pool ejects it at probe speed")
 def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
               probe_interval, fail_threshold, readmit_passes, retries,
-              saturation, hedge, timeout):
+              saturation, hedge, timeout, engine_watchdog):
     """Serve a bundle from N supervised replicas behind one router.
 
     Spawns REPLICAS watchdogged deployments of BUNDLE, health-probes
@@ -562,11 +576,14 @@ def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
     pool = ReplicaPool(probe_interval=probe_interval,
                        fail_threshold=fail_threshold,
                        readmit_passes=readmit_passes)
+    replica_env = ({"LAMBDIPY_ENGINE_WATCHDOG_S": str(engine_watchdog)}
+                   if engine_watchdog is not None else None)
     spawned = []
     try:
         spawned = pool.spawn_fleet(bundle_dir, replicas,
                                    base_name=fleet_name,
                                    runtime=LocalRuntime(),
+                                   env=replica_env,
                                    ready_timeout=timeout)
         pool.start()
         # inside the same guard: a router bind failure (port in use)

@@ -59,6 +59,10 @@ class Replica:
     url: str
     state: str = READY
     ready: bool = True
+    # the replica's engine watchdog declared its device transport
+    # wedged: the process answers /healthz but cannot serve — treated
+    # as a FAILED probe (ejection), not a readiness flap
+    wedged: bool = False
     managed: bool = False          # spawned through LocalRuntime by us
     spawn_env: dict | None = None  # env to reuse on rolling restart
     outstanding: int = 0
@@ -83,6 +87,7 @@ class Replica:
             "url": self.url,
             "state": self.state,
             "ready": self.ready,
+            "wedged": self.wedged,
             "outstanding": self.outstanding,
             "routed": self.routed,
             "retried": self.retried,
@@ -160,9 +165,21 @@ class ReplicaPool:
             if not ok:
                 self._fail_locked(r)
                 return False
-            r.consecutive_fails = 0
+            r.wedged = bool(h.get("wedged"))
             r.last_health = {k: h.get(k) for k in
-                             ("ready", "draining", "warming", "uptime_s")}
+                             ("ready", "draining", "warming", "wedged",
+                              "uptime_s")}
+            if r.wedged:
+                # the replica ANSWERS but its engine watchdog declared
+                # the device transport dead: that is a failure, not a
+                # readiness flap — eject at probe speed so the router
+                # stops feeding it, and keep failing until the engine
+                # reports recovered (readmission then takes the normal
+                # consecutive-passes path)
+                r.ready = False
+                self._fail_locked(r)
+                return False
+            r.consecutive_fails = 0
             pid = h.get("pid")
             if isinstance(pid, int):
                 if r.pid is not None and pid != r.pid:
@@ -249,10 +266,13 @@ class ReplicaPool:
         traffic — warm time-shares the device by design — so when the
         strict routable set is empty the router degrades to these
         instead of browning out the whole fleet (e.g. both replicas of
-        a fresh fleet warming their group-prefill programs at once)."""
+        a fresh fleet warming their group-prefill programs at once).
+        A WEDGED replica never qualifies: it is live but demonstrably
+        cannot serve — degrading to it would turn every fleet-wide brownout
+        into guaranteed timeouts."""
         with self._lock:
             return [r for r in self.replicas.values()
-                    if r.state == READY and not r.ready]
+                    if r.state == READY and not r.ready and not r.wedged]
 
     def acquire(self, r: Replica) -> None:
         with self._lock:

@@ -569,6 +569,8 @@ class FleetRouter:
                 if self.path == "/healthz":
                     pool = router_self.pool
                     routable = pool.routable()
+                    wedged = sorted(n for n, r in pool.replicas.items()
+                                    if r.wedged)
                     self.send(200, {
                         "ok": bool(routable),
                         "router": True,
@@ -576,6 +578,11 @@ class FleetRouter:
                         "replicas": {n: r.state
                                      for n, r in sorted(
                                          pool.replicas.items())},
+                        # replicas whose engine watchdog declared the
+                        # device wedged (they answer probes but cannot
+                        # serve) — the fleet-level view of the per-
+                        # replica /healthz wedged flag
+                        **({"wedged": wedged} if wedged else {}),
                         "affinity": router_self.affinity_on,
                         "block": router_self.block,
                     })

@@ -57,6 +57,26 @@ Design (all device work rides LlamaServer's compiled-program cache):
   reproducibility promise under arbitrary concurrent traffic. The
   per-slot knob vectors are assembled host-side before each segment.
 
+- FAULT ISOLATION (runtime/faults.py has the injection layer): every
+  device-side wait the engine thread makes (dispatch, per-segment fetch,
+  group prefill) is registered with a WATCHDOG monitor; a wait exceeding
+  ``watchdog_s`` marks the engine **wedged**, aborts every waiter, and
+  bumps the engine GENERATION so the stuck thread can never touch
+  restarted state (it observes the stale generation and exits at its
+  next step). On any engine failure — exception or watchdog trip — rows
+  that have delivered NO bytes to their client (non-streamed, or
+  streamed before the first chunk) are requeued and transparently
+  REPLAYED through a restarted engine (seeded per-row PRNG chains make
+  the replay bitwise the first attempt), bounded by ``max_replays``;
+  only partially-streamed rows surface the error. Repeated failures
+  inside ``degrade_window_s`` step a DEGRADATION LADDER down — pipeline
+  depth 1, then window bucketing off, then prefix-cache bypass — which
+  auto-restores after ``degrade_clean_s`` without a failure; everything
+  is published as ``EngineFaultStats`` under ``batching.faults``. Rows
+  whose waiter went away (closed stream socket) or whose
+  ``x-deadline-ms`` expired are CANCELLED at the next drain barrier
+  instead of decoding to completion.
+
 Opt-in per bundle: ``[payload.extra] batch_mode = "continuous"``
 (default keeps the window MicroBatcher when ``batch_window_ms`` is set).
 """
@@ -65,13 +85,27 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any
 
+from lambdipy_tpu.runtime.faults import EngineWatchdogTimeout, FaultPlan
 from lambdipy_tpu.utils.logs import get_logger
 
 log = get_logger("lambdipy.continuous")
 
 _entry_seq = itertools.count()
+
+
+class _StaleEngine(Exception):
+    """Raised inside an engine thread whose generation was superseded by
+    the watchdog (or a concurrent failure handler): the replacement
+    engine owns the batch state now, so the stale thread must unwind
+    without touching it."""
+
+
+class RequestCancelled(RuntimeError):
+    """A row cancelled at a drain barrier: its waiter disappeared
+    (closed stream socket) or its deadline expired mid-decode."""
 
 
 class ContinuousBatcher:
@@ -81,10 +115,15 @@ class ContinuousBatcher:
                  cache_len: int | None = None,
                  group_prefill_max: int = 256, policy: Any = None,
                  window_bucketing: bool = True, pipeline_depth: int = 2,
-                 synthetic_fetch_rtt_ms: float = 0.0):
+                 synthetic_fetch_rtt_ms: float = 0.0,
+                 watchdog_s: float = 0.0, max_replays: int = 1,
+                 faults: FaultPlan | None = None,
+                 degrade_window_s: float = 60.0,
+                 degrade_clean_s: float = 30.0):
         import jax
 
         from lambdipy_tpu.runtime.metrics import (DecodeWindowStats,
+                                                  EngineFaultStats,
                                                   PipelineStats)
 
         self.server = server
@@ -126,6 +165,39 @@ class ContinuousBatcher:
         # interleave with engine segments on the device queue instead
         # of stalling in-flight decode behind one wide program
         self.group_prefill_max = max(0, group_prefill_max)
+        # -- fault isolation -------------------------------------------------
+        # watchdog_s bounds every device-side wait the ENGINE thread
+        # makes (dispatch, per-segment fetch, group prefill) plus the
+        # request-thread prefix assembly; 0 disables — the default,
+        # because a first dispatch legitimately includes a multi-minute
+        # remote compile and the operator must size the timeout to the
+        # transport (env LAMBDIPY_ENGINE_WATCHDOG_S / bundle extra
+        # engine_watchdog_s / `lambdipy serve --engine-watchdog`)
+        self.watchdog_s = max(0.0, float(watchdog_s or 0.0))
+        # rows with no bytes delivered are transparently replayed through
+        # a restarted engine at most this many times before erroring
+        self.max_replays = max(0, int(max_replays))
+        self.faults = faults if faults is not None else FaultPlan.empty()
+        self.fault_stats = EngineFaultStats()
+        # degradation ladder: >= 2 failures inside degrade_window_s step
+        # the level (1: pipeline depth -> 1, 2: + window bucketing off,
+        # 3: + prefix cache bypassed); degrade_clean_s without a failure
+        # restores level 0
+        self.degrade_window_s = max(0.1, float(degrade_window_s))
+        self.degrade_clean_s = max(0.1, float(degrade_clean_s))
+        self._fail_times: list[float] = []
+        self._last_failure_t: float | None = None
+        self._had_failure = False        # recovery pending a clean fetch
+        # generation stamp: bumped on every engine failure so a stuck
+        # thread (hung device_get) can never mutate restarted state
+        self._gen = 0
+        self._waits: dict[int, dict] = {}   # watchdog-registered waits
+        self._wait_seq = itertools.count()
+        self._monitor: threading.Thread | None = None
+        # wedged-idle self-probe bookkeeping (_recovery_probe)
+        self._probe_t = 0.0
+        self._probe_live = False
+        self._probe_misses = 0   # consecutive failed probes -> backoff
         del jax  # imported for device presence; carry is built lazily
         self._lock = threading.Condition()
         self._joiners: list[dict] = []   # prefilled rows awaiting a slot
@@ -342,30 +414,293 @@ class ContinuousBatcher:
                                          self.cache_len, self.segment)
         return seg
 
+    # -- fault isolation -----------------------------------------------------
+
+    @property
+    def wedged(self) -> bool:
+        return self.fault_stats.wedged
+
+    @property
+    def degrade_level(self) -> int:
+        return self.fault_stats.degrade_level
+
+    def fault_state(self) -> dict:
+        """O(1) health snapshot for ``/healthz`` and the admission gate:
+        bare attribute reads, no locks — this runs once per probe
+        interval and once per accepted request."""
+        return {"wedged": self.fault_stats.wedged,
+                "degrade_level": self.fault_stats.degrade_level,
+                "restarting": (self.fault_stats.wedged
+                               and self._engine_running)}
+
+    def _device_wait(self, site: str, gen: int | None, fn=None, *args,
+                     kind: str = "engine"):
+        """Run a device-side wait under the watchdog: the wait is
+        registered so the monitor can bound it, the fault layer's site
+        hook fires first (so injected exceptions/delays/hangs land
+        exactly here), and a superseded engine generation aborts instead
+        of touching restarted state. ``kind='request'`` marks waits on
+        request threads (prefix assembly): the watchdog aborts their
+        injected hangs and counts the trip, but only engine-kind waits
+        wedge the whole engine."""
+        if self.watchdog_s <= 0 and not self.faults.rules:
+            # production default (no watchdog, empty fault plan): the
+            # register/monitor machinery can never fire, so skip its
+            # per-wait Event + two contended lock acquisitions — only
+            # the site stamp (failure attribution) and the stale-
+            # generation guard remain on the hot decode path
+            try:
+                out = fn(*args) if fn is not None else None
+            except Exception as e:  # noqa: BLE001 — stamp for attribution
+                if not hasattr(e, "fault_site"):
+                    e.fault_site = site
+                raise
+            if gen is not None and gen != self._gen:
+                raise _StaleEngine()
+            return out
+        wid = next(self._wait_seq)
+        abort = threading.Event()
+        rec = {"site": site, "t0": time.monotonic(), "gen": gen,
+               "kind": kind, "abort": abort, "tripped": False}
+        with self._lock:
+            self._waits[wid] = rec
+            self._ensure_monitor_locked()
+        try:
+            self.faults.check(site, interrupt=abort)
+            out = fn(*args) if fn is not None else None
+        except Exception as e:  # noqa: BLE001 — stamp for attribution
+            if not hasattr(e, "fault_site"):
+                e.fault_site = site
+            raise
+        finally:
+            with self._lock:
+                self._waits.pop(wid, None)
+        if abort.is_set():
+            raise EngineWatchdogTimeout(site, self.watchdog_s)
+        if gen is not None and gen != self._gen:
+            raise _StaleEngine()
+        return out
+
+    def _ensure_monitor_locked(self) -> None:
+        if self.watchdog_s <= 0:
+            return
+        if self._monitor is not None and self._monitor.is_alive():
+            return
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="engine-watchdog")
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        tick = max(0.01, min(0.2, self.watchdog_s / 4))
+        while True:
+            time.sleep(tick)
+            now = time.monotonic()
+            expired: list[dict] = []
+            with self._lock:
+                # tripped waits are DISOWNED: a real (non-injected) hang
+                # never returns, so its record lingers in _waits forever
+                # — counting it as live would block the idle branch (and
+                # the wedged self-probe) permanently
+                live = any(not rec["tripped"]
+                           for rec in self._waits.values())
+                if not live and not self._engine_running:
+                    if not self.fault_stats.wedged:
+                        self._monitor = None  # idle: next wait restarts us
+                        return
+                    # wedged with no work queued: behind a fleet the pool
+                    # EJECTS a wedged replica, so the clear-on-successful-
+                    # serve path can never run — no request will arrive to
+                    # prove the transport recovered. Self-probe instead.
+                    if (not self._probe_live
+                            and now - self._probe_t
+                            >= min(600.0, max(1.0, 2 * self.watchdog_s)
+                                   * (1 << self._probe_misses))):
+                        self._probe_live = True
+                        self._probe_t = now
+                        threading.Thread(target=self._recovery_probe,
+                                         daemon=True,
+                                         name="engine-recovery-probe"
+                                         ).start()
+                else:
+                    expired = [rec for rec in self._waits.values()
+                               if not rec["tripped"]
+                               and now - rec["t0"] > self.watchdog_s]
+                    for rec in expired:
+                        rec["tripped"] = True
+            for rec in expired:
+                # aborts an injected hang immediately; a REAL hung
+                # device call stays stuck, but its thread is already
+                # disowned by the generation bump below
+                rec["abort"].set()
+                if rec["kind"] == "engine":
+                    self._fail_engine(
+                        EngineWatchdogTimeout(rec["site"], self.watchdog_s),
+                        site=f"watchdog:{rec['site']}", gen=rec["gen"],
+                        wedged=True)
+                else:
+                    # request-thread wait (prefix assembly): the guard
+                    # raises to its own caller; record the trip only
+                    self.fault_stats.record_failure(
+                        f"watchdog:{rec['site']}", watchdog=True)
+
+    def _recovery_probe(self) -> None:
+        """Self-directed recovery for a wedged engine with nothing left
+        to serve: round-trip a trivial device op under the watchdog —
+        success proves the transport is answering again, clears the
+        wedge so ``/healthz`` goes ready, and the fleet pool readmits
+        through its normal consecutive-passes path. The probe runs
+        through the ``transport`` fault site, so a chaos plan with a
+        permanent transport fault keeps the engine deterministically
+        wedged. The device op runs on a DISPOSABLE inner thread with a
+        bounded join: a transport that is still truly hung swallows
+        that thread (nothing can unblock a real hang), but the probe
+        itself always terminates — future probes keep firing, at an
+        exponentially backed-off cadence so the leaked-thread rate
+        against a long-dead transport stays bounded."""
+        done = threading.Event()
+        ok: list = []
+
+        def op():
+            try:
+                import jax
+
+                self._device_wait(
+                    "transport", None,
+                    lambda: jax.device_get(jax.device_put(0)),
+                    kind="request")
+                ok.append(True)
+            except Exception:  # noqa: BLE001 — still wedged
+                pass
+            finally:
+                done.set()
+
+        threading.Thread(target=op, daemon=True,
+                         name="engine-recovery-probe-op").start()
+        # injected hangs resolve via the watchdog abort; a REAL hang
+        # just never sets done and the wait below times out
+        finished = done.wait(timeout=2 * self.watchdog_s + 1.0)
+        self._probe_live = False
+        if not (finished and ok):
+            self._probe_misses = min(self._probe_misses + 1, 9)
+            return
+        self._probe_misses = 0
+        with self._lock:
+            if self.fault_stats.wedged and not self._engine_running:
+                self.fault_stats.set_wedged(False)
+                self._had_failure = False
+                self.fault_stats.record_recovery()
+                log.info("engine recovery probe succeeded: wedge cleared")
+
+    def _cancel_due(self, entry: dict, now: float) -> bool:
+        return bool(entry.get("abandoned")) or (
+            entry.get("deadline_at") is not None
+            and now > entry["deadline_at"])
+
+    def _cancel_expired_locked(self, now: float) -> None:
+        """Drain-barrier cancellation: free slots (and the joiner queue)
+        of rows whose waiter is gone or whose deadline expired — decoding
+        them to completion would burn device time nobody reads."""
+        for slot, e in enumerate(self._active):
+            if e is not None and not e["done"] and self._cancel_due(e, now):
+                e["error"] = RequestCancelled(
+                    "cancelled at drain barrier: "
+                    + ("waiter gone" if e.get("abandoned")
+                       else "deadline expired"))
+                e["done"] = True
+                self._active[slot] = None
+                self.fault_stats.record_cancelled()
+        for j in [j for j in self._joiners if self._cancel_due(j, now)]:
+            j["error"] = RequestCancelled(
+                "cancelled while queued: "
+                + ("waiter gone" if j.get("abandoned")
+                   else "deadline expired"))
+            j["done"] = True
+            self._joiners.remove(j)
+            self.fault_stats.record_cancelled()
+
+    def _fail_engine(self, error: Exception, *, site: str,
+                     gen: int | None, wedged: bool = False) -> None:
+        """One engine failure, handled surgically instead of erroring the
+        world: done-but-undrained rows keep their bitwise results, rows
+        with no bytes delivered requeue for transparent replay (bounded
+        by ``max_replays``), everything else gets the error; the ladder
+        and wedged flag update; a replacement engine thread starts when
+        anything was requeued."""
+        with self._lock:
+            if gen is not None and gen != self._gen:
+                return  # a newer generation already handled this
+            self._gen += 1
+            now = time.monotonic()
+            self.fault_stats.record_failure(site, watchdog=wedged)
+            if wedged:
+                self.fault_stats.set_wedged(True)
+                self._probe_t = now  # first self-probe a full interval out
+                self._probe_misses = 0  # fresh wedge: base probe cadence
+            self._had_failure = True
+            self._last_failure_t = now
+            self._fail_times = [t for t in self._fail_times
+                                if now - t <= self.degrade_window_s]
+            self._fail_times.append(now)
+            if len(self._fail_times) >= 2 and \
+                    self.fault_stats.degrade_level < 3:
+                self.fault_stats.record_degrade(
+                    self.fault_stats.degrade_level + 1, site)
+            requeued = 0
+            survivors: list[dict] = []
+            for entry in self._joiners + [a for a in self._active if a]:
+                if entry["done"]:
+                    # completed mid-pipeline (slot held as garbage until
+                    # the next barrier): its bitwise-valid result is
+                    # already readable — never overwrite it
+                    continue
+                if (not entry["streamed"] and not entry["abandoned"]
+                        and entry["replays"] < self.max_replays):
+                    # no bytes have reached this row's client: reset to
+                    # its admitted state and replay. Seeded per-row PRNG
+                    # chains make the replay bitwise the first attempt.
+                    entry["replays"] += 1
+                    entry["toks"], entry["lps"] = [], []
+                    entry["disp"] = 0
+                    entry["eos_at"] = None
+                    entry["slot"] = None
+                    entry["packed"] = False
+                    entry["carry"] = None  # re-prefills in the engine
+                    survivors.append(entry)
+                    requeued += 1
+                else:
+                    entry["error"] = error
+                    entry["done"] = True
+            if requeued:
+                self.fault_stats.record_replays(attempted=requeued)
+            self._joiners = survivors
+            self._active = [None] * self.slots
+            self._carry = None  # rebuilt clean on restart
+            if survivors:
+                self._engine_running = True
+                threading.Thread(target=self._engine_loop,
+                                 args=(self._gen,), daemon=True,
+                                 name="continuous-batch").start()
+            else:
+                self._engine_running = False
+            self._lock.notify_all()
+        log.error("continuous-batch engine failed at %s: %s "
+                  "(replaying %d row(s), degrade level %d%s)",
+                  site, error, requeued, self.fault_stats.degrade_level,
+                  ", wedged" if wedged else "")
+
     # -- engine --------------------------------------------------------------
 
-    def _engine_loop(self):
+    def _engine_loop(self, gen: int):
         try:
-            self._engine_body()
+            self._engine_body(gen)
+        except _StaleEngine:
+            log.debug("stale engine generation exited")
         except Exception as e:  # noqa: BLE001 — waiters must never hang
-            log.error("continuous-batch engine failed: %s", e)
-            with self._lock:
-                # a row that already completed mid-pipeline (done=True,
-                # slot held as garbage until the next drain barrier) has
-                # a bitwise-valid result — don't overwrite it with the
-                # engine error its waiter would then raise
-                for entry in self._joiners + [a for a in self._active
-                                              if a and not a["done"]]:
-                    entry["error"] = e
-                    entry["done"] = True
-                self._joiners.clear()
-                self._active = [None] * self.slots
-                self._carry = None  # rebuilt clean on restart
-                self._engine_running = False
-                self._lock.notify_all()
+            self._fail_engine(e, site=getattr(e, "fault_site", "engine"),
+                              gen=gen)
 
-    def _engine_body(self):
-        import time
+    def _engine_body(self, gen: int):
         from collections import deque
 
         import jax
@@ -408,30 +743,49 @@ class ContinuousBatcher:
             # another segment is queued behind it. (On the remote tunnel
             # block_until_ready returns at submission — there the marker
             # undercounts busy time, which is the conservative side.)
-            jax.block_until_ready(rec["toks"])
+            # Both device waits run under the watchdog: a wedged
+            # transport trips it instead of blocking the engine forever.
+            self._device_wait("transport", gen,
+                              jax.block_until_ready, rec["toks"])
             t_ready = time.monotonic()
             if self.synthetic_fetch_rtt_ms > 0:
                 # transport model: the RTT starts once device compute is
                 # done and blocks only THIS fetch — segments already
                 # queued behind it keep the device busy meanwhile
                 time.sleep(self.synthetic_fetch_rtt_ms / 1e3)
+
             # one host fetch per segment: on a remote-tunnel transport
             # every device_get of a fresh result pays one RTT (~66 ms
             # measured), so the logprob block rides the same fetch — and
             # only when some active request actually asked for it
-            if rec["need_lp"]:
-                block, lp_block = map(np.asarray,
-                                      jax.device_get((rec["toks"],
-                                                      rec["lps"])))
-            else:
-                block = np.asarray(jax.device_get(rec["toks"]))
-                lp_block = None
+            def fetch():
+                if rec["need_lp"]:
+                    return tuple(map(np.asarray,
+                                     jax.device_get((rec["toks"],
+                                                     rec["lps"]))))
+                return np.asarray(jax.device_get(rec["toks"])), None
+
+            block, lp_block = self._device_wait("segment_fetch", gen, fetch)
             t_end = time.monotonic()
+            if self._had_failure:
+                # first successful fetch after a failure: the engine is
+                # demonstrably serving again — clear the wedge and count
+                # the recovery (the ladder restores separately, after a
+                # clean interval)
+                self._had_failure = False
+                self.fault_stats.record_recovery()
+                if self.fault_stats.wedged:
+                    self.fault_stats.set_wedged(False)
             self.window_stats.record_segment(
                 attended=rec["attended"], window_read=rec["window_read"],
                 full_window=rec["full_window"], window=rec["window"])
             wasted = 0
             with self._lock:
+                if gen != self._gen:
+                    # a failure handler requeued these entries while we
+                    # were fetching: booking this block against their
+                    # RESET state would corrupt the replay
+                    raise _StaleEngine()
                 self.segments_run += 1
                 for slot, entry in rec["rows"]:
                     if entry["done"]:
@@ -459,6 +813,10 @@ class ContinuousBatcher:
                             or len(entry["toks"]) >= n:
                         entry["done"] = True
                         self.requests_served += 1
+                        if entry["replays"]:
+                            # a requeued row completed through the
+                            # restarted engine — the replay delivered
+                            self.fault_stats.record_replays(succeeded=1)
                 self._lock.notify_all()
             # fetch clock starts AFTER block_until_ready so fetch_block_s
             # measures only the device_get transport window (plus the
@@ -474,6 +832,21 @@ class ContinuousBatcher:
                 # drain barriers, so in-flight segments never see their
                 # slot repurposed under them. ----
                 with self._lock:
+                    if gen != self._gen:
+                        raise _StaleEngine()
+                    now = time.monotonic()
+                    # a clean interval since the last failure restores
+                    # the degradation ladder to full service
+                    if self.fault_stats.degrade_level \
+                            and self._last_failure_t is not None \
+                            and now - self._last_failure_t \
+                            > self.degrade_clean_s:
+                        self.fault_stats.record_restore()
+                        self._fail_times.clear()
+                    # rows whose waiter went away or whose deadline
+                    # expired cancel here, before they take (or keep)
+                    # a slot
+                    self._cancel_expired_locked(now)
                     for slot, e in enumerate(self._active):
                         if e is not None and e["done"]:
                             # finished mid-pipeline: the slot decoded as
@@ -503,26 +876,123 @@ class ContinuousBatcher:
                         return
                 if self._carry is None:
                     self._carry = self._init_carry()
-                raw = [a for a in packing if a.get("carry") is None]
-                carried = [a for a in packing if a.get("carry") is not None]
+                raw = [a for a in packing if a.get("carry") is None
+                       and a.get("prefix_toks") is None
+                       and a["s"] <= self.group_prefill_max]
+                # replayed LONG-prompt rows (admitted via the request
+                # thread's chunked prefill) never belong in the ragged
+                # group program: their s buckets past group_prefill_max
+                # into a shape the warm never compiled — under a
+                # watchdog the fresh compile would trip mid-recovery
+                # and burn the replay budget. Re-run the chunked path
+                # instead: same programs as admission, bitwise.
+                long_replay = [a for a in packing
+                               if a.get("carry") is None
+                               and a.get("prefix_toks") is None
+                               and a["s"] > self.group_prefill_max]
+                carried = [a for a in packing
+                           if a.get("carry") is not None]
+                # replayed prefix rows lost their continuation carry
+                # with the failed engine: re-assemble from the cached
+                # prefix KV here (same program, same tokens — bitwise),
+                # erroring only the row whose prefix has meanwhile been
+                # evicted
+                for j in [a for a in packing if a.get("carry") is None
+                          and a.get("prefix_toks") is not None]:
+                    try:
+                        j["carry"] = self._device_wait(
+                            "prefix_assemble", gen,
+                            self._prefill_prefix_row, j["prefix_toks"],
+                            j["row"], j["s"], j)
+                        carried.append(j)
+                    except (_StaleEngine, EngineWatchdogTimeout):
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        with self._lock:
+                            if gen != self._gen:
+                                # a failure handler (watchdog) already
+                                # requeued this entry under a new
+                                # generation — touching it here would
+                                # error a row the replay is about to
+                                # serve
+                                raise _StaleEngine() from None
+                            log.error("prefix re-assembly failed: %s", e)
+                            self.fault_stats.record_failure(
+                                "prefix_assemble")
+                            j["error"], j["done"] = e, True
+                            self._active[j["slot"]] = None
+                            self._lock.notify_all()
+                for j in long_replay:
+                    ck = self.server.prefill_chunk
+                    chunked = (ck and j["s"] > ck
+                               and self.cache_len % ck == 0)
+                    try:
+                        j["carry"] = self._device_wait(
+                            "group_prefill", gen,
+                            (self._prefill_row_chunked if chunked
+                             else self._prefill_row),
+                            j["row"], j["s"], j)
+                        carried.append(j)
+                    except (_StaleEngine, EngineWatchdogTimeout):
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        with self._lock:
+                            if gen != self._gen:
+                                raise _StaleEngine() from None
+                            log.error("long-row replay prefill "
+                                      "failed: %s", e)
+                            self.fault_stats.record_failure(
+                                getattr(e, "fault_site",
+                                        "group_prefill"))
+                            j["error"], j["done"] = e, True
+                            self._active[j["slot"]] = None
+                            self._lock.notify_all()
                 group_carry = None
                 if raw:
                     try:
-                        group_carry = self._prefill_group(raw)
+                        group_carry = self._device_wait(
+                            "group_prefill", gen, self._prefill_group, raw)
                         with self._lock:
                             self.prefill_groups += 1
                             self.rows_group_prefilled += len(raw)
+                    except (_StaleEngine, EngineWatchdogTimeout):
+                        # the watchdog already failed the engine (and
+                        # requeued these entries) — unwind, don't touch
+                        raise
                     except Exception as e:  # noqa: BLE001
-                        # a group-prefill failure (fresh-bucket compile
-                        # OOM, transient device error) errors ONLY the
-                        # raw joiners — in-flight decode and carried
-                        # joiners keep running, matching the isolation
-                        # request-thread prefill used to provide
-                        log.error("group prefill failed: %s", e)
+                        # a group-prefill failure (injected fault,
+                        # fresh-bucket compile OOM, transient device
+                        # error) stays scoped to the raw joiners —
+                        # in-flight decode and carried joiners keep
+                        # running. Joiners under their replay budget
+                        # requeue for the next barrier's group call
+                        # (fault gone -> bitwise the first attempt);
+                        # the rest error explicitly.
                         with self._lock:
+                            if gen != self._gen:
+                                # the failure handler already requeued
+                                # these entries under a new generation
+                                # (their slot is gone and their replay
+                                # budget spent on OUR failure): erroring
+                                # them here would race the replay that
+                                # is about to serve them
+                                raise _StaleEngine() from None
+                            log.error("group prefill failed: %s", e)
+                            self.fault_stats.record_failure(
+                                getattr(e, "fault_site", "group_prefill"))
+                            retried = 0
                             for j in raw:
-                                j["error"], j["done"] = e, True
                                 self._active[j["slot"]] = None
+                                if j["replays"] < self.max_replays:
+                                    j["replays"] += 1
+                                    j["slot"] = None
+                                    self._joiners.append(j)
+                                    retried += 1
+                                else:
+                                    j["error"], j["done"] = e, True
+                            if retried:
+                                self.fault_stats.record_replays(
+                                    attempted=retried)
                             self._lock.notify_all()
                         raw = []
                 for src, joiner in enumerate(raw):
@@ -541,7 +1011,14 @@ class ContinuousBatcher:
                 # so the fetch overlaps the next segment's compute ----
                 cause = None
                 while True:
+                    # ladder level >= 1 forces the synchronous depth-1
+                    # loop: a failing device gets one outstanding wait
+                    # at a time, the easiest shape to recover
+                    eff_depth = (1 if self.fault_stats.degrade_level >= 1
+                                 else self.pipeline_depth)
                     with self._lock:
+                        if gen != self._gen:
+                            raise _StaleEngine()
                         live = [(slot, e)
                                 for slot, e in enumerate(self._active)
                                 if e is not None]
@@ -590,7 +1067,11 @@ class ContinuousBatcher:
                     # their out-of-window scatters drop harmlessly
                     # (nothing reads them).
                     window = self.cache_len
-                    if self.window_bucketing and positions:
+                    if self.window_bucketing and positions \
+                            and self.fault_stats.degrade_level < 2:
+                        # ladder level >= 2 pins the full-window program
+                        # (no first-use window-variant compiles while
+                        # the device is misbehaving)
                         needed = max(positions) + self.segment
                         window = min(_next_bucket(needed, 16),
                                      self.cache_len)
@@ -601,11 +1082,16 @@ class ContinuousBatcher:
                     else:
                         seg = seg_full
                     t_disp = time.monotonic()
-                    with server._mesh_ctx():
-                        (toks, lps), self._carry = seg(
-                            server.params, jnp.asarray(t_host),
-                            jnp.asarray(k_host), jnp.asarray(p_host),
-                            *self._carry, eos_op)
+
+                    def dispatch():
+                        with server._mesh_ctx():
+                            return seg(server.params, jnp.asarray(t_host),
+                                       jnp.asarray(k_host),
+                                       jnp.asarray(p_host),
+                                       *self._carry, eos_op)
+
+                    (toks, lps), self._carry = self._device_wait(
+                        "segment_dispatch", gen, dispatch)
                     # attended = per-row sum of positions each step's
                     # attention actually covered (pos + 1 keys at write
                     # index pos)
@@ -621,7 +1107,7 @@ class ContinuousBatcher:
                         "full_window": (len(positions) * self.segment
                                         * self.cache_len)})
                     pstats.record_dispatch(len(inflight))
-                    if len(inflight) >= self.pipeline_depth:
+                    if len(inflight) >= eff_depth:
                         collect_one()
                 # ---- drain: collect everything behind the frontier so
                 # the barrier above sees host-truth slots and a
@@ -669,12 +1155,14 @@ class ContinuousBatcher:
         smaller than the prefix cache's full window)."""
         import numpy as np
 
-        from lambdipy_tpu.sched import current_request_class
+        from lambdipy_tpu.sched import (current_request_class,
+                                        current_request_deadline_ms)
 
         if max_new_tokens <= 0:
             return None
         row = np.asarray(prompt_row, np.int32).reshape(-1).tolist()
         s = len(row)
+        deadline_ms = current_request_deadline_ms()
         entry = {"n": max_new_tokens, "eos_id": eos_id,
                  "temperature": temperature, "top_k": top_k, "top_p": top_p,
                  "seed": seed, "toks": [], "lps": [],
@@ -692,6 +1180,15 @@ class ContinuousBatcher:
                  # include the cached prefix) — the window bucketing's
                  # host-side view of how far this row's cache reaches
                  "pos0": s,
+                 # fault isolation: replay budget consumed so far, and
+                 # the delivery markers that decide replay-vs-error (a
+                 # row with bytes on the wire can only error); the
+                 # prompt row/prefix persist so a replayed entry can
+                 # re-prefill from its admitted state
+                 "replays": 0, "streamed": False, "abandoned": False,
+                 "row": row, "s": s, "prefix_toks": None,
+                 "deadline_at": (time.monotonic() + deadline_ms / 1e3
+                                 if deadline_ms else None),
                  "cls": current_request_class(), "seq": next(_entry_seq)}
         if prefix is not None:
             # a prefix carry can only pack into an engine whose slots
@@ -707,8 +1204,14 @@ class ContinuousBatcher:
             if self.cache_len != cache_width(pentry[0]):
                 return None
             entry["pos0"] = pentry[1] + s
-            entry["carry"] = self._prefill_prefix_row(prefix, row, s,
-                                                      entry, pentry)
+            entry["prefix_toks"] = \
+                np.asarray(prefix, np.int32).reshape(-1).tolist()
+            # guarded as a request-kind wait: the watchdog bounds an
+            # injected prefix-assembly hang (the abort raises here, to
+            # this caller) without wedging the shared engine
+            entry["carry"] = self._device_wait(
+                "prefix_assemble", None, self._prefill_prefix_row,
+                prefix, row, s, entry, pentry, kind="request")
             with self._lock:
                 self.prefix_joins += 1
         else:
@@ -730,7 +1233,6 @@ class ContinuousBatcher:
             # chunks when the server has prefill_chunk, so engine
             # segments interleave instead of stalling.
             if s <= self.group_prefill_max:
-                entry["row"], entry["s"] = row, s
                 entry["carry"] = None
             else:
                 ck = self.server.prefill_chunk
@@ -743,7 +1245,8 @@ class ContinuousBatcher:
             self._joiners.append(entry)
             if not self._engine_running:
                 self._engine_running = True
-                threading.Thread(target=self._engine_loop, daemon=True,
+                threading.Thread(target=self._engine_loop,
+                                 args=(self._gen,), daemon=True,
                                  name="continuous-batch").start()
         return entry
 
@@ -815,41 +1318,59 @@ class ContinuousBatcher:
                 seed=seed, eos_id=eos_id, segment=segment, prefix=prefix,
                 return_logprobs=return_logprobs)
             return
-        delivered = 0
-        latched = False
-        while not latched:
-            with self._lock:
-                while (not entry["done"]
-                       and len(entry["toks"]) <= delivered):
-                    self._lock.wait(timeout=1.0)
-                if entry["error"] is not None:
-                    raise entry["error"]
-                if entry["done"] and len(entry["toks"]) <= delivered:
+        try:
+            delivered = 0
+            latched = False
+            while not latched:
+                with self._lock:
+                    while (not entry["done"]
+                           and len(entry["toks"]) <= delivered):
+                        self._lock.wait(timeout=1.0)
+                    if entry["error"] is not None:
+                        raise entry["error"]
+                    if entry["done"] and len(entry["toks"]) <= delivered:
+                        return
+                    toks = list(entry["toks"])
+                    lps = list(entry["lps"])
+                    take = min(len(toks), max_new_tokens)
+                    if take > delivered:
+                        # bytes are about to reach the client: from here
+                        # on an engine failure can only surface as an
+                        # error (a terminal stream event), never as a
+                        # transparent replay — marked under the SAME
+                        # lock the failure handler takes, so there is no
+                        # window where a replay could splice a restarted
+                        # decode onto an already-started stream
+                        entry["streamed"] = True
+                chunk = toks[delivered:take]
+                lp_chunk = lps[delivered:take] if entry["want_lp"] else None
+                if not chunk:
                     return
-                toks = list(entry["toks"])
-                lps = list(entry["lps"])
-            take = min(len(toks), max_new_tokens)
-            chunk = toks[delivered:take]
-            lp_chunk = lps[delivered:take] if entry["want_lp"] else None
-            if not chunk:
-                return
-            # eos latch parity with the fused path: fill the rest of
-            # the delivering chunk with eos (the device latch would
-            # have), then stop the stream at this segment boundary
-            if eos_id is not None and eos_id in chunk:
-                cut = chunk.index(eos_id) + 1
-                chunk = chunk[:cut] + [eos_id] * (len(chunk) - cut)
-                if lp_chunk is not None:
-                    lp_chunk = lp_chunk[:cut] + [0.0] * (len(chunk) - cut)
-                latched = True
-            delivered = take
-            arr = np.asarray([chunk], np.int32)
-            if entry["want_lp"]:
-                yield arr, np.asarray([lp_chunk], np.float32)
-            else:
-                yield arr
-            if delivered >= max_new_tokens:
-                return
+                # eos latch parity with the fused path: fill the rest of
+                # the delivering chunk with eos (the device latch would
+                # have), then stop the stream at this segment boundary
+                if eos_id is not None and eos_id in chunk:
+                    cut = chunk.index(eos_id) + 1
+                    chunk = chunk[:cut] + [eos_id] * (len(chunk) - cut)
+                    if lp_chunk is not None:
+                        lp_chunk = lp_chunk[:cut] \
+                            + [0.0] * (len(chunk) - cut)
+                    latched = True
+                delivered = take
+                arr = np.asarray([chunk], np.int32)
+                if entry["want_lp"]:
+                    yield arr, np.asarray([lp_chunk], np.float32)
+                else:
+                    yield arr
+                if delivered >= max_new_tokens:
+                    return
+        finally:
+            # a closed generator (client went away mid-stream) leaves the
+            # row with no waiter: flag it so the engine cancels the slot
+            # at its next drain barrier instead of decoding to completion
+            with self._lock:
+                if not entry["done"]:
+                    entry["abandoned"] = True
 
     def stats(self) -> dict:
         with self._lock:
@@ -858,6 +1379,11 @@ class ContinuousBatcher:
                     "segment": self.segment, "cache_len": self.cache_len,
                     "window_bucketing": self.window_bucketing,
                     "pipeline_depth": self.pipeline_depth,
+                    "watchdog_s": self.watchdog_s,
+                    "max_replays": self.max_replays,
+                    "faults": self.fault_stats.report(),
+                    **({"fault_plan": self.faults.describe()}
+                       if self.faults.active() else {}),
                     "pipeline": self.pipeline_stats.report(),
                     "decode_window": self.window_stats.report(),
                     "segments_run": self.segments_run,

@@ -1,0 +1,208 @@
+"""Deterministic fault injection for the serve path.
+
+The continuous engine's recovery machinery (watchdog, replay-on-restart,
+degradation ladder — runtime/continuous.py) only earns trust if every
+path through it runs in CI, not just when a TPU transport happens to
+wedge. This module gives tests and ``bench.py --chaos`` a deterministic
+way to make named SITES misbehave:
+
+========================  ====================================================
+site                      where it fires
+========================  ====================================================
+``segment_dispatch``      the engine thread dispatching a decode segment
+``segment_fetch``         the per-segment ``device_get`` in the collector
+``group_prefill``         the engine's ragged b-row joiner prefill
+``prefix_assemble``       continue-prefill from a cached prefix KV
+``transport``             the ``block_until_ready`` device wait before fetch
+========================  ====================================================
+
+Each site can raise (``exception``), stall (``delay``, ``ms=``) or block
+indefinitely (``hang`` — until the plan is released, the watchdog aborts
+the wait, or a hard cap expires so test runs never leak threads).
+
+Specs are strings so they travel through env/bundle extras::
+
+    LAMBDIPY_FAULT="segment_fetch:hang@seg=3"      # hang from the 3rd fetch on
+    LAMBDIPY_FAULT="group_prefill:exception"        # raise on the 1st call
+    LAMBDIPY_FAULT="transport:delay@ms=200,n=2"     # 200 ms stall, twice
+    LAMBDIPY_FAULT="segment_fetch:exception;transport:delay"  # multiple rules
+
+Grammar: ``site:kind[@key=val,key=val]`` joined by ``;``. ``seg=N`` is
+the 1-based per-site call index where the rule starts firing (default 1),
+``n=K`` how many calls it fires for (default 1 for exception/delay,
+unlimited for hang; ``n=inf`` forces unlimited), ``ms=X`` the delay
+duration. Call counting is per site and strictly deterministic — the
+whole point is that a chaos case replays identically run after run.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+SITES = ("segment_dispatch", "segment_fetch", "group_prefill",
+         "prefix_assemble", "transport")
+KINDS = ("exception", "delay", "hang")
+_KIND_ALIASES = {"error": "exception", "raise": "exception",
+                 "sleep": "delay", "stall": "delay", "block": "hang"}
+
+# injected hangs still resolve after this many seconds even if nothing
+# releases or aborts them — a safety net so a test that forgets teardown
+# cannot leak a thread for the life of the process
+HANG_CAP_S = 300.0
+
+
+class InjectedFault(RuntimeError):
+    """An exception (or aborted hang) raised by the fault layer.
+
+    ``fault_site`` lets the engine's failure handler attribute the
+    failure without string-parsing the message."""
+
+    def __init__(self, site: str, kind: str, occurrence: int):
+        self.fault_site = site
+        self.fault_kind = kind
+        self.occurrence = occurrence
+        super().__init__(
+            f"injected {kind} at {site} (call #{occurrence})")
+
+
+class EngineWatchdogTimeout(TimeoutError):
+    """A device-side wait exceeded the engine watchdog. Raised to the
+    waiters of an engine the watchdog declared wedged, and by guarded
+    request-thread waits whose injected hang the watchdog aborted."""
+
+    def __init__(self, site: str, timeout_s: float):
+        self.fault_site = f"watchdog:{site}"
+        super().__init__(
+            f"engine watchdog: {site} wait exceeded {timeout_s:.3g}s")
+
+
+@dataclass
+class FaultRule:
+    site: str
+    kind: str
+    seg: int = 1            # 1-based call index where firing starts
+    n: float = 1            # firings (math.inf = permanent)
+    ms: float = 50.0        # delay duration
+    fired: int = 0
+
+    def matches(self, count: int) -> bool:
+        return self.seg <= count and self.fired < self.n
+
+    def describe(self) -> str:
+        span = "inf" if math.isinf(self.n) else str(int(self.n))
+        return (f"{self.site}:{self.kind}@seg={self.seg},n={span}"
+                + (f",ms={self.ms:g}" if self.kind == "delay" else ""))
+
+
+class FaultPlan:
+    """A deterministic set of :class:`FaultRule`\\ s plus the per-site
+    call counters they key on. An empty plan is a no-op and costs one
+    ``if`` per site check — safe to leave wired in production."""
+
+    def __init__(self, rules: list[FaultRule] | None = None):
+        self.rules = list(rules or ())
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._release = threading.Event()
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def empty(cls) -> "FaultPlan":
+        return cls([])
+
+    @classmethod
+    def from_spec(cls, spec: str | None) -> "FaultPlan":
+        """Parse ``site:kind@k=v,...;site2:...``; unknown sites/kinds and
+        malformed params raise ``ValueError`` — a typo in a chaos spec
+        must fail the run loudly, not silently test nothing."""
+        rules: list[FaultRule] = []
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            head, _, params = part.partition("@")
+            site, sep, kind = head.partition(":")
+            site, kind = site.strip(), kind.strip().lower()
+            kind = _KIND_ALIASES.get(kind, kind)
+            if not sep or site not in SITES or kind not in KINDS:
+                raise ValueError(
+                    f"bad fault spec {part!r}: want site:kind with site in "
+                    f"{SITES} and kind in {KINDS}")
+            rule = FaultRule(site=site, kind=kind,
+                             n=(math.inf if kind == "hang" else 1))
+            for kv in filter(None, (p.strip() for p in params.split(","))):
+                key, eq, val = kv.partition("=")
+                key = key.strip().lower()
+                try:
+                    if key in ("seg", "at"):
+                        rule.seg = max(1, int(val))
+                    elif key == "n":
+                        rule.n = math.inf if val.strip() in ("inf", "-1") \
+                            else max(1, int(val))
+                    elif key == "ms":
+                        rule.ms = max(0.0, float(val))
+                    else:
+                        raise ValueError(key)
+                except ValueError:
+                    raise ValueError(
+                        f"bad fault param {kv!r} in {part!r} "
+                        f"(known: seg=N, n=K|inf, ms=X)") from None
+            rules.append(rule)
+        return cls(rules)
+
+    @classmethod
+    def from_env(cls, environ=None) -> "FaultPlan":
+        return cls.from_spec((environ or os.environ).get("LAMBDIPY_FAULT"))
+
+    # -- the injection point -------------------------------------------------
+
+    def check(self, site: str, interrupt: threading.Event | None = None
+              ) -> None:
+        """Called once per site invocation. No-op without a matching
+        rule; otherwise sleeps (delay), raises (exception), or blocks
+        (hang) until :meth:`release`, the ``interrupt`` event (the
+        watchdog's abort), or the hard cap — then raises, because a wait
+        the system gave up on must not look like a success."""
+        if not self.rules:
+            return
+        with self._lock:
+            count = self._counts.get(site, 0) + 1
+            self._counts[site] = count
+            rule = next((r for r in self.rules
+                         if r.site == site and r.matches(count)), None)
+            if rule is not None:
+                rule.fired += 1
+        if rule is None:
+            return
+        if rule.kind == "delay":
+            time.sleep(rule.ms / 1e3)
+            return
+        if rule.kind == "hang":
+            deadline = time.monotonic() + HANG_CAP_S
+            while time.monotonic() < deadline:
+                if self._release.wait(0.02):
+                    break
+                if interrupt is not None and interrupt.is_set():
+                    break
+        raise InjectedFault(site, rule.kind, count)
+
+    # -- lifecycle / introspection -------------------------------------------
+
+    def release(self) -> None:
+        """Unblock every in-flight (and future) hang — test teardown."""
+        self._release.set()
+
+    def active(self) -> bool:
+        return bool(self.rules)
+
+    def counts(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def describe(self) -> list[str]:
+        return [r.describe() for r in self.rules]

@@ -39,6 +39,13 @@ class HandlerState:
     # interval, so it must stay a bare flag read, no locks or
     # serialization.
     warming_fn: Callable[[], bool] | None = None
+    # optional O(1) engine-fault probe: {"wedged", "restarting",
+    # "degrade_level"} from the continuous engine's fault-isolation
+    # layer. /healthz flips ready:false (and reports wedged:true) on it
+    # so the fleet router ejects a wedged replica at probe speed, and
+    # server admission 503s instead of queueing requests into a dead
+    # engine. Same cost contract as warming_fn: bare attribute reads.
+    engine_fault_fn: Callable[[], dict] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
@@ -424,6 +431,24 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             pd = extra.get(
                 "pipeline_depth",
                 _os.environ.get("LAMBDIPY_PIPELINE_DEPTH", "2"))
+            # fault isolation knobs (runtime/faults.py): the watchdog
+            # bounds device-side waits (0 = off — size it to the
+            # transport: a first dispatch legitimately includes a
+            # remote compile), max_replays caps transparent replays of
+            # rows that delivered no bytes, and a fault spec arms the
+            # deterministic injection sites for chaos tests. Extra wins
+            # over env, like the pipeline-depth knob (the env vars are
+            # the CLI bridge: `lambdipy serve --engine-watchdog`).
+            wd = extra.get(
+                "engine_watchdog_s",
+                _os.environ.get("LAMBDIPY_ENGINE_WATCHDOG_S", "0"))
+            mr = extra.get(
+                "max_replays",
+                _os.environ.get("LAMBDIPY_MAX_REPLAYS", "1"))
+            fspec = extra.get("fault_spec",
+                              _os.environ.get("LAMBDIPY_FAULT", ""))
+            from lambdipy_tpu.runtime.faults import FaultPlan
+
             batcher = continuous = ContinuousBatcher(
                 server, slots=int(extra.get("batch_max", 8)),
                 segment=int(extra.get("batch_segment", 16)),
@@ -431,7 +456,11 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 policy=sched_policy,
                 window_bucketing=str(wb).lower() not in ("0", "false",
                                                          "off"),
-                pipeline_depth=int(pd))
+                pipeline_depth=int(pd),
+                watchdog_s=float(wd or 0),
+                max_replays=int(mr),
+                faults=(FaultPlan.from_spec(str(fspec))
+                        if str(fspec).strip() else None))
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 
@@ -579,6 +608,11 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         sub-block prompts pass through. Fail-open by construction —
         ``route`` returns 0 on any store failure."""
         if prefix_store is None or prefix is not None or len(prompt) != 1:
+            return prompt, prefix
+        if continuous is not None and continuous.degrade_level >= 3:
+            # degradation ladder level 3: a repeatedly-failing engine
+            # bypasses the prefix cache — full-prompt prefill through
+            # the plainest path until a clean interval restores it
             return prompt, prefix
         if continuous is not None and \
                 continuous.cache_len != server.model.cfg.max_len:
@@ -966,6 +1000,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         # bare dict read — GIL-atomic, no lock: exactly what a
         # once-per-probe-interval health check may cost
         warming_fn=lambda: bool(warm_state["in_flight"]),
+        engine_fault_fn=(continuous.fault_state
+                         if continuous is not None else None),
         meta={
             "model": spec["model"], "quant": spec.get("quant"),
             "sharded": mesh is not None, "tokenizer": tokenizer is not None,

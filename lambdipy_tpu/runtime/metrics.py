@@ -203,6 +203,91 @@ class PipelineStats:
 
 
 @dataclass
+class EngineFaultStats:
+    """Counters + gauges for the continuous engine's fault-isolation
+    layer (the ``batching.faults`` block on ``/metrics``). ``failures``
+    keys engine failures by site (a ``watchdog:`` prefix marks waits the
+    monitor gave up on); ``replays`` track rows transparently requeued
+    through a restarted engine and how many of those completed;
+    ``cancelled`` counts rows dropped at a drain barrier because their
+    waiter went away (closed stream) or their deadline expired.
+    ``degrade_level`` is the ladder position (0 = full service, 1 =
+    pipeline depth forced to 1, 2 = + window bucketing off, 3 = + prefix
+    cache bypassed); ``degrade_steps`` counts entries into each level
+    with the site that caused the last step. ``recoveries`` counts the
+    first successful device fetch after a failure (the engine is
+    demonstrably serving again), ``restores`` the ladder resetting to 0
+    after a clean interval. ``wedged`` mirrors what ``/healthz``
+    reports."""
+
+    failures: dict = field(default_factory=dict)   # site -> count
+    watchdog_trips: int = 0
+    replays_attempted: int = 0
+    replays_succeeded: int = 0
+    cancelled: int = 0
+    degrade_level: int = 0                          # gauge
+    degrade_steps: dict = field(default_factory=dict)  # level -> entries
+    last_degrade_cause: str | None = None
+    recoveries: int = 0
+    restores: int = 0
+    wedged: bool = False                            # gauge
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_failure(self, site: str, *, watchdog: bool = False) -> None:
+        with self._lock:
+            self.failures[site] = self.failures.get(site, 0) + 1
+            if watchdog:
+                self.watchdog_trips += 1
+
+    def record_replays(self, *, attempted: int = 0, succeeded: int = 0
+                       ) -> None:
+        with self._lock:
+            self.replays_attempted += int(attempted)
+            self.replays_succeeded += int(succeeded)
+
+    def record_cancelled(self, n: int = 1) -> None:
+        with self._lock:
+            self.cancelled += int(n)
+
+    def record_degrade(self, level: int, cause: str) -> None:
+        with self._lock:
+            self.degrade_level = int(level)
+            self.degrade_steps[str(level)] = \
+                self.degrade_steps.get(str(level), 0) + 1
+            self.last_degrade_cause = cause
+
+    def record_restore(self) -> None:
+        with self._lock:
+            if self.degrade_level:
+                self.restores += 1
+            self.degrade_level = 0
+
+    def record_recovery(self) -> None:
+        with self._lock:
+            self.recoveries += 1
+
+    def set_wedged(self, wedged: bool) -> None:
+        with self._lock:
+            self.wedged = bool(wedged)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "failures": dict(self.failures),
+                "watchdog_trips": self.watchdog_trips,
+                "replays": {"attempted": self.replays_attempted,
+                            "succeeded": self.replays_succeeded},
+                "cancelled": self.cancelled,
+                "degrade_level": self.degrade_level,
+                "degrade_steps": dict(self.degrade_steps),
+                "last_degrade_cause": self.last_degrade_cause,
+                "recoveries": self.recoveries,
+                "restores": self.restores,
+                "wedged": self.wedged,
+            }
+
+
+@dataclass
 class RouterStats:
     """Counters for the fleet front-door (fleet/router.py), exported on
     the router's ``/metrics`` under ``router``. ``retries`` counts

@@ -32,6 +32,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
+from lambdipy_tpu.runtime.continuous import RequestCancelled
 from lambdipy_tpu.runtime.loader import BootReport, load_bundle
 from lambdipy_tpu.runtime.metrics import LatencyStats
 from lambdipy_tpu.sched import (
@@ -253,10 +254,20 @@ class BundleServer:
                         warming = bool(warming_fn()) if warming_fn else False
                     except Exception:  # noqa: BLE001 — health never 500s
                         warming = False
+                    # wedged = the engine watchdog gave up on a device
+                    # wait: liveness stays 200 (the process answers) but
+                    # ready flips false and the explicit wedged flag
+                    # lets the fleet prober EJECT (not merely
+                    # deprioritize) the replica at probe speed
+                    engine = server_self._engine_fault_state()
+                    wedged = bool(engine.get("wedged"))
                     self._send(200, {
                         "ok": True,
-                        "ready": not server_self.draining and not warming,
+                        "ready": (not server_self.draining and not warming
+                                  and not wedged),
                         "warming": warming,
+                        "wedged": wedged,
+                        **({"engine": engine} if engine else {}),
                         "pid": os.getpid(),
                         "draining": server_self.draining,
                         "bundle": str(server_self.bundle_dir),
@@ -340,6 +351,22 @@ class BundleServer:
                 if draining:
                     server_self.sched.admission.count_shed("draining", cls)
                     self._send_shed(Shed(503, "draining", 1.0),
+                                    openai=openai)
+                    return None
+                # wedged-engine accept hole: while the engine is wedged
+                # AND a restart is in flight (replays queued behind a
+                # dead device), admitting more work would queue requests
+                # into an engine that cannot serve them — shed instead.
+                # A wedged engine with NO restart running still admits:
+                # that request IS the recovery probe (it restarts the
+                # engine; success clears the wedge, another trip re-503s
+                # followers).
+                engine = server_self._engine_fault_state()
+                if engine.get("wedged") and engine.get("restarting"):
+                    with server_self._inflight_lock:
+                        server_self._inflight -= 1
+                    server_self.sched.admission.count_shed("wedged", cls)
+                    self._send_shed(Shed(503, "wedged", 2.0),
                                     openai=openai)
                     return None
                 prefill, decode = _request_token_counts(
@@ -446,6 +473,18 @@ class BundleServer:
                     try:
                         result = server_self.boot.handler.invoke(
                             server_self.boot.state, request)
+                    except RequestCancelled as e:
+                        # not a handler bug: the engine cancelled the row
+                        # at a drain barrier (deadline expired / waiter
+                        # gone). Answer shed-style — 503 + Retry-After —
+                        # so clients back off and retry instead of
+                        # treating it as a server fault.
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "cancelled", cls)
+                        self._send_shed(Shed(503, str(e), 1.0))
+                        return
                     except Exception as e:  # handler bug or bad payload shape
                         server_self.stats.record_error()
                         log_event(log, "invoke failed", error=str(e),
@@ -494,6 +533,15 @@ class BundleServer:
                     try:
                         result = server_self.boot.handler.invoke(
                             server_self.boot.state, internal)
+                    except RequestCancelled as e:
+                        # drain-barrier cancellation, not a server fault:
+                        # shed-style 503 so OpenAI clients retry/back off
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "cancelled", cls)
+                        self._send_shed(Shed(503, str(e), 1.0), openai=True)
+                        return
                     except Exception as e:
                         server_self.stats.record_error()
                         self._send(500, {"error": {"message": str(e),
@@ -642,6 +690,18 @@ class BundleServer:
         return Handler
 
     # -- lifecycle ----------------------------------------------------------
+
+    def _engine_fault_state(self) -> dict:
+        """O(1) snapshot of the continuous engine's fault layer (empty
+        for handlers without one) — feeds /healthz and the admission
+        gate, so it must never raise or take serving-path locks."""
+        fn = getattr(self.boot.state, "engine_fault_fn", None)
+        if fn is None:
+            return {}
+        try:
+            return dict(fn())
+        except Exception:  # noqa: BLE001 — health must never 500
+            return {}
 
     def serve_forever(self):
         log_event(log, "serving", port=self.port, bundle=str(self.bundle_dir))

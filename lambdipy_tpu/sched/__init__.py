@@ -36,7 +36,7 @@ from lambdipy_tpu.sched.queue import CLASSES, RequestQueue, Ticket
 
 __all__ = ["Scheduler", "Shed", "Ticket", "CLASSES",
            "set_request_context", "clear_request_context",
-           "current_request_class"]
+           "current_request_class", "current_request_deadline_ms"]
 
 
 # -- request context ---------------------------------------------------------
@@ -59,6 +59,14 @@ def clear_request_context() -> None:
 
 def current_request_class() -> str:
     return getattr(_ctx, "cls", None) or "interactive"
+
+
+def current_request_deadline_ms() -> float | None:
+    """The admitted request's ``x-deadline-ms``, if it carried one — the
+    continuous engine uses it to cancel rows whose deadline expired
+    mid-decode at the next drain barrier instead of decoding them to
+    completion."""
+    return getattr(_ctx, "deadline_ms", None)
 
 
 # -- scheduler ---------------------------------------------------------------

@@ -6,9 +6,15 @@
 # Python 3.10) must turn the build red by itself, not hide behind
 # --continue-on-collection-errors in the main run.
 #
-# Phase 2 is the EXACT tier-1 command from ROADMAP.md (its exit code
-# still gates; the only change is that success falls through to the
-# later phases instead of exiting inline).
+# Phase 2 is the ROADMAP.md tier-1 suite split into TWO module shards
+# (2a: the engine/serving stack, 2b: everything else), each with its
+# own 870 s timeout — the single-process run was flirting with the
+# ceiling (~750-810 s observed, high machine variance; ROADMAP
+# carry-over). Same flags, same tests, union = tests/ (2b ignores
+# exactly 2a's modules, so a NEW module lands in 2b by default); the
+# aggregate DOTS_PASSED still prints. Keeping the continuous-engine
+# modules together in 2a preserves their shared session-scoped
+# tiny_server compile cache.
 #
 # Phase 3 is a quick forced-CPU bench.py smoke (tiny model) so a bench
 # orchestration regression turns tier-1 red, not measurement day.
@@ -19,7 +25,10 @@
 # nonzero on either regression); phase 6 the FLEET (2 CPU replicas
 # behind the affinity router, one SIGKILLed mid-traffic — zero lost
 # requests, ejection, supervisor respawn, re-admission, rolling
-# restart — the slow tests in tests/test_fleet.py).
+# restart — the slow tests in tests/test_fleet.py); phase 7 the CHAOS
+# matrix (bench.py --chaos: every runtime/faults.py site x {exception,
+# delay, hang} injected into a live continuous engine — no waiter
+# outlives its bound, zero silent losses, replay parity is bitwise).
 #
 # Every phase prints its wall-clock so the budget breakdown is visible
 # in the log (ROADMAP open item: phase 2 runs close to its 870 s cap).
@@ -42,9 +51,35 @@ if grep -qE '^ERROR |[0-9]+ errors? in ' /tmp/_t1_collect.log; then
 fi
 phase_end "phase 1"
 
-phase_begin "phase 2: tier-1 suite (ROADMAP.md verbatim)"
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
-phase_end "phase 2"
+# the engine/serving stack: these share conftest.py's session-scoped
+# tiny_server (one compiled-program cache) and are the wall-clock-heavy
+# half of the suite
+ENGINE_SHARD="tests/test_continuous.py tests/test_continuous_pipeline.py \
+tests/test_faults.py tests/test_prefixstore.py \
+tests/test_decode_attention.py tests/test_runtime.py \
+tests/test_fleet.py tests/test_e2e.py"
+
+set -o pipefail
+phase_begin "phase 2a: tier-1 engine/serving shard"
+rm -f /tmp/_t1a.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest $ENGINE_SHARD \
+    -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1a.log
+rc=${PIPESTATUS[0]}
+phase_end "phase 2a"
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+phase_begin "phase 2b: tier-1 remainder shard"
+ignores=""
+for m in $ENGINE_SHARD; do ignores="$ignores --ignore=$m"; done
+rm -f /tmp/_t1b.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ $ignores \
+    -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1b.log
+rc=${PIPESTATUS[0]}
+phase_end "phase 2b"
+echo DOTS_PASSED=$(cat /tmp/_t1a.log /tmp/_t1b.log \
+    | grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' | tr -cd . | wc -c)
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
 phase_begin "phase 3: bench.py CPU smoke"
@@ -91,4 +126,17 @@ if ! timeout -k 10 900 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 6"
+
+# Phase 7: chaos smoke — the deterministic fault-injection matrix.
+# bench.py --chaos exits nonzero if any injected fault (site x kind,
+# plus a permanent-hang wedge case) hangs a waiter past the watchdog
+# bound, silently loses a request, breaks replay bitwise-parity, or
+# leaves the engine unable to serve afterwards.
+phase_begin "phase 7: chaos matrix (bench.py --chaos)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --chaos; then
+    echo "FATAL: bench.py --chaos matrix failed" >&2
+    exit 1
+fi
+phase_end "phase 7"
 exit 0
