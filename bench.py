@@ -449,6 +449,35 @@ def shared_prefix_record(*, n_requests: int = 8, prefix_len: int = 512,
     }
 
 
+def _build_fleet_bundle(tmp, *, n_new: int, block: int,
+                        name: str = "fleet-bench"):
+    """Assemble the tiny llama bundle the fleet sweeps serve (prefix
+    cache on, deterministic init params so every replica is bitwise the
+    same server)."""
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.bundle import assemble_bundle
+    from lambdipy_tpu.recipes.schema import load_recipe_dict
+
+    doc = {
+        "schema": 1, "name": name, "version": "0.1",
+        "device": "any", "base_layer": "jax-tpu", "requires": [],
+        "payload": {
+            "model": "llama-tiny",
+            "handler": "lambdipy_tpu.runtime.handlers:generate_handler",
+            "params": "init", "dtype": "float32",
+            "extra": {"max_new_tokens": str(n_new), "serve_aot": "0",
+                      "warm_group_prefill": "0",
+                      "prefix_cache_mb": "64",
+                      "prefix_block": str(block)},
+        },
+    }
+    result = build_recipe(load_recipe_dict(doc), tmp / "work",
+                          run_smoke=False)
+    bundle = tmp / "bundle"
+    assemble_bundle(result, bundle, with_payload=True)
+    return bundle
+
+
 def fleet_record(*, replicas: int = 2, requests_per_group: int = 6,
                  groups: int = 2, prefix_len: int = 64, suffix_len: int = 8,
                  n_new: int = 8, block: int = 16) -> dict:
@@ -470,30 +499,11 @@ def fleet_record(*, replicas: int = 2, requests_per_group: int = 6,
 
     import jax
 
-    from lambdipy_tpu.buildengine import build_recipe
-    from lambdipy_tpu.bundle import assemble_bundle
     from lambdipy_tpu.fleet import FleetRouter, ReplicaPool
-    from lambdipy_tpu.recipes.schema import load_recipe_dict
     from lambdipy_tpu.runtime.server import BundleServer
 
     tmp = Path(tempfile.mkdtemp(prefix="lambdipy-fleet-bench-"))
-    doc = {
-        "schema": 1, "name": "fleet-bench", "version": "0.1",
-        "device": "any", "base_layer": "jax-tpu", "requires": [],
-        "payload": {
-            "model": "llama-tiny",
-            "handler": "lambdipy_tpu.runtime.handlers:generate_handler",
-            "params": "init", "dtype": "float32",
-            "extra": {"max_new_tokens": str(n_new), "serve_aot": "0",
-                      "warm_group_prefill": "0",
-                      "prefix_cache_mb": "64",
-                      "prefix_block": str(block)},
-        },
-    }
-    result = build_recipe(load_recipe_dict(doc), tmp / "work",
-                          run_smoke=False)
-    bundle = tmp / "bundle"
-    assemble_bundle(result, bundle, with_payload=True)
+    bundle = _build_fleet_bundle(tmp, n_new=n_new, block=block)
 
     rng = np.random.default_rng(0)
     rows = [row for _ in range(groups)
@@ -951,6 +961,262 @@ def chaos_record(*, kinds=("exception", "delay", "hang"),
     }
 
 
+def chaos_fleet_record(*, replicas: int = 2, n_new: int = 6,
+                       block: int = 16, prefix_len: int = 32,
+                       requests: int = 8, spill_cap: int = 32) -> dict:
+    """Fleet-boundary chaos matrix (CPU-runnable): a live ``replicas``-
+    server fleet behind the resilient router, with the NETWORK made to
+    lie through the runtime/faults.py router-side sites — dropped
+    connections (``route_connect``), connections dying mid-body
+    (``route_body``), latency spikes (``route_latency``), flapping
+    replicas (``probe``) — plus a transient fleet-wide shed burst.
+
+    Asserted per case, end to end: ZERO silent losses (every
+    non-streamed request is either delivered BITWISE identical to the
+    direct single-server reference or answered with an explicit shed
+    carrying ``Retry-After``), bounded tail latency under the injected
+    latency spike, full recovery after a flap (every replica routable
+    again), and SPILL-QUEUE ABSORPTION — the shed-burst case must
+    complete with 0 client-visible 429/503s because the router parked
+    the burst in its sched-backed queue and drained it on recovery."""
+    import tempfile
+    import threading as _threading
+    import urllib.error
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+    from pathlib import Path
+
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.fleet import FleetRouter, ReplicaPool
+    from lambdipy_tpu.runtime.faults import FaultPlan
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    tmp = Path(tempfile.mkdtemp(prefix="lambdipy-chaos-fleet-"))
+    bundle = _build_fleet_bundle(tmp, n_new=n_new, block=block,
+                                 name="chaos-fleet")
+    rng = np.random.default_rng(0)
+    rows = _shared_prefix_rows(rng, n_requests=requests,
+                               prefix_len=prefix_len, suffix_len=4,
+                               vocab=512)
+
+    def completion(base: str, row: list, timeout: float = 120) -> list:
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": row, "max_tokens": n_new,
+                             "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())["choices"][0]["tokens"]
+
+    servers = [BundleServer(bundle, warmup=False).start_background()
+               for _ in range(replicas)]
+    try:
+        # bitwise reference + compile warm on EVERY replica (identical
+        # init params -> identical outputs; warming all of them keeps
+        # fault-window latencies about the fault, not about XLA)
+        refs = {}
+        for s in servers:
+            base = f"http://127.0.0.1:{s.port}"
+            for row in rows:
+                out = completion(base, row)
+                prev = refs.setdefault(tuple(row), out)
+                if prev != out:
+                    raise AssertionError(
+                        "replicas disagree on identical-params greedy "
+                        "decode — the parity reference is broken")
+
+        def run_case(case: str, *, fault_spec: str | None = None,
+                     during=None, fail_threshold: int = 1,
+                     expect_failover: bool = False,
+                     expect_spill: bool = False,
+                     expect_flap: bool = False,
+                     max_latency_s: float = 30.0,
+                     allow_shed: bool = False) -> dict:
+            plan = (FaultPlan.from_spec(fault_spec) if fault_spec
+                    else FaultPlan.empty())
+            pool = ReplicaPool(probe_interval=0.2,
+                               fail_threshold=fail_threshold,
+                               readmit_passes=2, probe_timeout=5.0,
+                               faults=plan)
+            for i, s in enumerate(servers):
+                pool.attach(f"r{i}", f"http://127.0.0.1:{s.port}")
+            pool.probe_all()
+            pool.start()
+            router = FleetRouter(
+                pool, affinity_on=True, block=block, max_retries=3,
+                backoff_s=0.02, backoff_cap_s=0.3, request_timeout=120,
+                spill_cap=spill_cap, spill_max_wait_s=30.0,
+                breaker_fails=4, breaker_open_s=0.5,
+                retry_budget=0.5, faults=plan).start_background()
+            base = f"http://127.0.0.1:{router.port}"
+            timer = None
+            if during is not None:
+                timer = _threading.Timer(0.6, during)
+                timer.start()
+            delivered = sheds = 0
+            silent: list[str] = []
+            lat: list[float] = []
+
+            def one(row):
+                nonlocal delivered, sheds
+                t0 = time.monotonic()
+                try:
+                    out = completion(base, row)
+                    lat.append(time.monotonic() - t0)
+                    if out != refs[tuple(row)]:
+                        silent.append(
+                            f"{case}: WRONG tokens for {row[:4]}...")
+                        return
+                    delivered += 1
+                except urllib.error.HTTPError as e:
+                    lat.append(time.monotonic() - t0)
+                    body = json.loads(e.read() or b"{}")
+                    hint = body.get("retry_after_s") or \
+                        (body.get("error") or {}).get("retry_after_s")
+                    if e.code in (429, 503, 504) and (
+                            hint is not None or e.code == 504):
+                        sheds += 1  # explicit, priced — not a loss
+                    else:
+                        silent.append(f"{case}: status {e.code} "
+                                      f"without a shed contract")
+                except Exception as e:  # noqa: BLE001 — a silent loss
+                    lat.append(time.monotonic() - t0)
+                    silent.append(f"{case}: {type(e).__name__}: {e}")
+
+            with ThreadPoolExecutor(max_workers=4) as ex:
+                list(ex.map(one, rows))
+            if expect_flap:
+                # the flap must BITE (an ejection lands — the traffic
+                # may all complete before the first faulty probe sweep,
+                # so wait for the probe clock, not the request clock)...
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline and not any(
+                        r.ejections for r in pool.replicas.values()):
+                    time.sleep(0.05)
+                # ...and then END: every replica routable again
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline and \
+                        len(pool.routable()) < replicas:
+                    time.sleep(0.1)
+                if len(pool.routable()) < replicas:
+                    raise AssertionError(
+                        f"chaos-fleet {case}: fleet never recovered "
+                        f"from the flap")
+            plan.release()
+            stats = router.stats.report()
+            pool_rep = pool.report()
+            router.stop()
+            pool.close()
+            if silent:
+                raise AssertionError(
+                    f"chaos-fleet {case}: silent losses: {silent[:3]}")
+            if not allow_shed and sheds:
+                raise AssertionError(
+                    f"chaos-fleet {case}: {sheds} client-visible sheds "
+                    f"— the fleet boundary amplified instead of "
+                    f"absorbing")
+            if delivered + sheds != len(rows):
+                raise AssertionError(
+                    f"chaos-fleet {case}: {delivered}+{sheds} != "
+                    f"{len(rows)} — a request vanished")
+            if max(lat) > max_latency_s:
+                raise AssertionError(
+                    f"chaos-fleet {case}: tail latency {max(lat):.1f}s "
+                    f"exceeded the {max_latency_s:.0f}s bound")
+            if expect_failover and stats["failovers"] < 1:
+                raise AssertionError(
+                    f"chaos-fleet {case}: no failover recorded — the "
+                    f"fault never bit")
+            if expect_spill and (stats["spill"]["spilled"] < 1
+                                 or stats["spill"]["drained"] < 1):
+                raise AssertionError(
+                    f"chaos-fleet {case}: spill queue never absorbed "
+                    f"the burst (stats: {stats['spill']})")
+            if expect_flap and not any(rep["ejections"] >= 1
+                                       for rep in pool_rep.values()):
+                raise AssertionError(
+                    f"chaos-fleet {case}: no ejection recorded — the "
+                    f"flap never bit")
+            return {"case": case, "delivered": delivered, "sheds": sheds,
+                    "p_max_s": round(max(lat), 3),
+                    "failovers": stats["failovers"],
+                    "retries": stats["retries"],
+                    "spill": stats["spill"],
+                    "ejections": {n: rep["ejections"]
+                                  for n, rep in pool_rep.items()}}
+
+        cases = [
+            # dropped connections: the first 3 forwards die on the wire
+            run_case("drop", fault_spec="route_connect:exception@seg=1,n=3",
+                     expect_failover=True),
+            # latency spike: 300 ms injected into 6 forwards — delivered,
+            # with the tail bounded
+            run_case("latency",
+                     fault_spec="route_latency:delay@ms=300,n=6",
+                     max_latency_s=20.0),
+            # connection dies mid-body: the response was read but never
+            # arrived intact; non-streamed, so the retry is safe
+            run_case("midbody",
+                     fault_spec="route_body:exception@seg=1,n=2",
+                     expect_failover=True),
+            # flapping replicas: probes fail (both replicas eject on
+            # fail_threshold=1), then pass — traffic rides the spill
+            # queue through the window and the fleet fully readmits
+            run_case("flap", fault_spec="probe:exception@seg=3,n=6",
+                     expect_flap=True),
+        ]
+        # spill absorption: a transient FLEET-WIDE shed burst (both
+        # replicas draining for ~1 s). Queue capacity suffices, so the
+        # acceptance bar is zero client-visible 429/503s.
+        for s in servers:
+            s.draining = True
+
+        def _undrain():
+            for s in servers:
+                s.draining = False
+
+        cases.append(run_case("shed_burst", during=_undrain,
+                              expect_spill=True))
+    finally:
+        for s in servers:
+            try:
+                s.draining = False
+                s.stop()
+            except Exception:  # noqa: BLE001
+                pass
+    return {
+        "mode": "chaos_fleet",
+        "platform": jax.devices()[0].platform,
+        "replicas": replicas,
+        "requests": len(rows),
+        "n_new": n_new,
+        "spill_cap": spill_cap,
+        "cases": cases,
+        "passed": True,
+    }
+
+
+def _chaos_fleet_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chaos-fleet", action="store_true")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--n-new", type=int, default=6)
+    ap.add_argument("--block", type=int, default=16)
+    ap.add_argument("--spill-cap", type=int, default=32)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(chaos_fleet_record(
+        replicas=args.replicas, requests=args.requests, n_new=args.n_new,
+        block=args.block, spill_cap=args.spill_cap)))
+    return 0
+
+
 def _chaos_main() -> int:
     import argparse
 
@@ -1130,6 +1396,12 @@ def main() -> int:
         # pipeline depths + depth-2 tok/s beating depth-1 under a
         # synthetic per-fetch transport RTT
         return _pipeline_main()
+    if "--chaos-fleet" in sys.argv:
+        # CPU-runnable fleet-boundary chaos matrix: router-side network
+        # faults (drop/latency/mid-body/flap) + a fleet-wide shed burst
+        # against a live fleet — zero silent losses, bounded tails, and
+        # spill-queue absorption asserted (exits nonzero on violation)
+        return _chaos_fleet_main()
     if "--chaos" in sys.argv:
         # CPU-runnable chaos matrix: every fault site x kind injected
         # into a live engine — watchdog bounds, replay parity, ladder

@@ -543,9 +543,44 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
                    "`lambdipy serve --engine-watchdog`): a replica "
                    "whose device wait hangs flips its /healthz to "
                    "wedged and the pool ejects it at probe speed")
+@click.option("--attach", "attach_urls", multiple=True, metavar="NAME=URL",
+              help="attach an externally managed replica (remote host "
+                   "or existing deployment): probed/ejected/readmitted/"
+                   "cache-warmed like spawned ones, but never restarted "
+                   "or drained by this pool; repeatable, and with "
+                   "--replicas 0 the fleet is attach-only")
+@click.option("--spill-cap", type=int, default=64, show_default=True,
+              help="router spill-queue capacity: when the WHOLE fleet "
+                   "sheds or nothing is routable, non-streamed requests "
+                   "park here and drain as replicas recover instead of "
+                   "relaying the 429/503 (0 disables)")
+@click.option("--spill-max-wait", type=float, default=30.0,
+              show_default=True,
+              help="max seconds a spilled request waits before shedding "
+                   "with the queue's own Retry-After estimate")
+@click.option("--breaker-fails", type=int, default=5, show_default=True,
+              help="consecutive forward failures that open a replica's "
+                   "circuit breaker; after --breaker-open-s one "
+                   "half-open probe decides readmission (0 disables)")
+@click.option("--breaker-open-s", type=float, default=2.0,
+              show_default=True,
+              help="seconds a breaker stays open before its half-open "
+                   "probe (doubles on repeated failures, capped)")
+@click.option("--retry-budget", type=float, default=0.2, show_default=True,
+              help="fleet-wide retry-to-primary ratio over a sliding "
+                   "window: when spent, failures relay instead of "
+                   "re-sending — no retry storms into a degraded fleet "
+                   "(0 disables)")
+@click.option("--fault-spec", default=None,
+              help="router-side network fault injection "
+                   "(runtime/faults.py grammar over the route_connect/"
+                   "route_body/route_latency/probe sites), default "
+                   "$LAMBDIPY_FLEET_FAULT")
 def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
               probe_interval, fail_threshold, readmit_passes, retries,
-              saturation, hedge, timeout, engine_watchdog):
+              saturation, hedge, timeout, engine_watchdog, attach_urls,
+              spill_cap, spill_max_wait, breaker_fails, breaker_open_s,
+              retry_budget, fault_spec):
     """Serve a bundle from N supervised replicas behind one router.
 
     Spawns REPLICAS watchdogged deployments of BUNDLE, health-probes
@@ -557,9 +592,25 @@ def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
 
     from lambdipy_tpu.fleet import FleetRouter, ReplicaPool
     from lambdipy_tpu.runtime.deploy import LocalRuntime
+    from lambdipy_tpu.runtime.faults import FaultPlan
 
-    if replicas < 1:
-        raise click.ClickException("--replicas must be >= 1")
+    if replicas < 1 and not attach_urls:
+        raise click.ClickException(
+            "--replicas must be >= 1 (or pass --attach for an "
+            "attach-only fleet)")
+    attached: list[tuple[str, str]] = []
+    for spec in attach_urls:
+        aname, sep, aurl = spec.partition("=")
+        if not sep or not aname or not aurl.startswith("http"):
+            raise click.ClickException(
+                f"--attach wants NAME=URL (http...), got {spec!r}")
+        attached.append((aname, aurl))
+    try:
+        fleet_faults = (FaultPlan.from_spec(fault_spec)
+                        if fault_spec is not None
+                        else FaultPlan.from_env(var="LAMBDIPY_FLEET_FAULT"))
+    except ValueError as e:
+        raise click.ClickException(str(e))
     hedge_ms: float | str = 0
     if hedge not in ("off", "0", ""):
         if hedge == "p95":
@@ -571,26 +622,39 @@ def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
                 raise click.ClickException(
                     f"--hedge must be 'off', 'p95' or a threshold in "
                     f"ms, got {hedge!r}")
-    bundle_dir = _resolve_bundle(bundle, registry_dir)
+    # an attach-only fleet (--replicas 0) never deploys the bundle, so
+    # don't require it to resolve locally
+    bundle_dir = (_resolve_bundle(bundle, registry_dir)
+                  if replicas >= 1 else None)
     fleet_name = name or bundle.split("/")[-1]
     pool = ReplicaPool(probe_interval=probe_interval,
                        fail_threshold=fail_threshold,
-                       readmit_passes=readmit_passes)
+                       readmit_passes=readmit_passes,
+                       faults=fleet_faults)
     replica_env = ({"LAMBDIPY_ENGINE_WATCHDOG_S": str(engine_watchdog)}
                    if engine_watchdog is not None else None)
     spawned = []
     try:
-        spawned = pool.spawn_fleet(bundle_dir, replicas,
-                                   base_name=fleet_name,
-                                   runtime=LocalRuntime(),
-                                   env=replica_env,
-                                   ready_timeout=timeout)
+        if replicas >= 1:
+            spawned = pool.spawn_fleet(bundle_dir, replicas,
+                                       base_name=fleet_name,
+                                       runtime=LocalRuntime(),
+                                       env=replica_env,
+                                       ready_timeout=timeout)
+        for aname, aurl in attached:
+            pool.probe_one(pool.attach(aname, aurl))
         pool.start()
         # inside the same guard: a router bind failure (port in use)
         # must not leak N supervised replica processes
         router = FleetRouter(pool, port=port, affinity_on=affinity,
                              block=block, max_retries=retries,
-                             saturation=saturation, hedge_ms=hedge_ms)
+                             saturation=saturation, hedge_ms=hedge_ms,
+                             spill_cap=spill_cap,
+                             spill_max_wait_s=spill_max_wait,
+                             breaker_fails=breaker_fails,
+                             breaker_open_s=breaker_open_s,
+                             retry_budget=retry_budget,
+                             faults=fleet_faults)
     except BaseException:
         # a half-spawned fleet must not leak processes — including on
         # Ctrl-C, which lands mid-boot more often than anywhere else
@@ -599,7 +663,10 @@ def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
         raise
     click.echo(json.dumps({
         "ready": True, "port": router.port, "replicas": len(spawned),
+        "attached": [a for a, _ in attached],
         "affinity": affinity, "block": block,
+        "spill_cap": spill_cap, "breaker_fails": breaker_fails,
+        "retry_budget": retry_budget,
         "urls": {r.name: r.url for r in spawned},
     }))
 

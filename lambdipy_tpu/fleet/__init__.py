@@ -1,13 +1,22 @@
 """Replica fleet: prefix-affinity router + health-driven replica pool.
 
 The front door that multiplies the per-replica serve stack across N
-supervised bundle servers — see pool.py (spawn/probe/eject/readmit/
-rolling drain), affinity.py (rendezvous hashing over leading token
-blocks, matching the radix prefix cache), and router.py (the HTTP
-front-door with retry/hedge/metrics-aggregation).
+supervised bundle servers — see pool.py (spawn/attach/probe/eject/
+readmit/rolling drain), affinity.py (rendezvous hashing over leading
+token blocks, matching the radix prefix cache), router.py (the HTTP
+front-door with retry/hedge/spill/metrics-aggregation), breaker.py
+(per-replica circuit breakers + the fleet-wide retry budget), and
+spill.py (the router-level overload parking lot built from the sched
+layer's queue/policy pieces).
 """
 
-from lambdipy_tpu.fleet.affinity import DEFAULT_BLOCK, pick_replica, prefix_key
+from lambdipy_tpu.fleet.affinity import (
+    DEFAULT_BLOCK,
+    pick_replica,
+    prefix_key,
+    warm_prompt,
+)
+from lambdipy_tpu.fleet.breaker import CircuitBreaker, RetryBudget
 from lambdipy_tpu.fleet.pool import (
     DRAINING,
     EJECTED,
@@ -18,6 +27,7 @@ from lambdipy_tpu.fleet.pool import (
     ReplicaPool,
 )
 from lambdipy_tpu.fleet.router import FleetRouter
+from lambdipy_tpu.fleet.spill import SpillQueue
 
 __all__ = [
     "DEFAULT_BLOCK",
@@ -25,10 +35,14 @@ __all__ = [
     "EJECTED",
     "READY",
     "STOPPED",
+    "CircuitBreaker",
     "FleetError",
     "FleetRouter",
     "Replica",
     "ReplicaPool",
+    "RetryBudget",
+    "SpillQueue",
     "pick_replica",
     "prefix_key",
+    "warm_prompt",
 ]

@@ -103,6 +103,42 @@ def prefix_key(request: dict, *, block: int = DEFAULT_BLOCK,
     return None
 
 
+def warm_prompt(request: dict, *, block: int = DEFAULT_BLOCK,
+                key_blocks: int = DEFAULT_KEY_BLOCKS):
+    """The request's leading whole-block prompt head as a REPLAYABLE
+    prompt (token list or string) — what an affinity-aware cache warm
+    should prefill on a fresh replica so the radix store holds the
+    fleet's hot prefixes again. None when the prompt has no whole block
+    (nothing the radix store could cache) or when the shape cannot be
+    replayed standalone (mixed explicit-prefix + string suffix)."""
+    if not isinstance(request, dict):
+        return None
+    block = max(1, int(block))
+    key_blocks = max(1, int(key_blocks))
+    head: list = []
+    pref = _flat_int_row(request.get("prefix"))
+    if pref:
+        head.extend(pref[: key_blocks * block])
+    toks = _flat_int_row(request.get("tokens"))
+    if toks is None:
+        toks = _flat_int_row(request.get("prompt"))
+    if toks is not None:
+        seq = head + toks
+        n = min(len(seq) // block, key_blocks) * block
+        return seq[:n] if n else None
+    text = request.get("text")
+    if text is None and isinstance(request.get("prompt"), str):
+        text = request["prompt"]
+    if isinstance(text, str) and text and not head:
+        n_chars = block * CHARS_PER_TOKEN
+        n = min(len(text) // n_chars, key_blocks) * n_chars
+        return text[:n] if n else None
+    if head:
+        n = min(len(head) // block, key_blocks) * block
+        return head[:n] if n else None
+    return None
+
+
 def pick_replica(key: bytes, names) -> str | None:
     """Rendezvous-hash ``key`` onto one of ``names`` (any iterable of
     replica names). Deterministic; removing a name never remaps keys

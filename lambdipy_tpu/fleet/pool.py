@@ -65,6 +65,10 @@ class Replica:
     wedged: bool = False
     managed: bool = False          # spawned through LocalRuntime by us
     spawn_env: dict | None = None  # env to reuse on rolling restart
+    # the on_admit hook (affinity-aware cache warming) fired for this
+    # replica's current admission; reset on ejection so a readmitted
+    # replica — whose radix cache died with its worker — warms again
+    warmed: bool = False
     outstanding: int = 0
     consecutive_fails: int = 0
     consecutive_passes: int = 0
@@ -102,11 +106,22 @@ class Replica:
 class ReplicaPool:
     def __init__(self, *, probe_interval: float = 1.0,
                  fail_threshold: int = 1, readmit_passes: int = 2,
-                 probe_timeout: float = 5.0):
+                 probe_timeout: float = 5.0, faults=None):
         self.probe_interval = max(0.05, float(probe_interval))
         self.fail_threshold = max(1, int(fail_threshold))
         self.readmit_passes = max(1, int(readmit_passes))
         self.probe_timeout = float(probe_timeout)
+        # deterministic chaos for the PROBE path (runtime/faults.py
+        # ``probe`` site): an empty plan costs one ``if`` per probe
+        if faults is None:
+            from lambdipy_tpu.runtime.faults import FaultPlan
+            faults = FaultPlan.empty()
+        self.faults = faults
+        # fired (outside the pool lock, from the prober thread) the
+        # first time a replica becomes routable after attach/spawn or
+        # after an ejection — the router hooks affinity-aware cache
+        # warming here; exceptions are swallowed (warming is advisory)
+        self.on_admit = None
         self.replicas: dict[str, Replica] = {}
         self.runtime: LocalRuntime | None = None
         self._lock = threading.Lock()
@@ -116,8 +131,14 @@ class ReplicaPool:
     # -- membership ---------------------------------------------------------
 
     def attach(self, name: str, url: str) -> Replica:
-        """Register an externally managed replica (tests, or fronting
-        deployments the operator already made)."""
+        """Register an externally managed replica (a remote host, a
+        deployment the operator already made, or a test stub). Attached
+        replicas are FIRST-CLASS for routing and health — probed,
+        ejected, readmitted, and cache-warmed exactly like spawned
+        ones — but have a probe-only lifecycle: ``rolling_restart`` and
+        ``begin_drain`` refuse them (this pool cannot redeploy a
+        process it does not own), and ``stop_all`` detaches without
+        touching the remote process."""
         r = Replica(name=name, url=url.rstrip("/"))
         with self._lock:
             if name in self.replicas:
@@ -157,10 +178,14 @@ class ReplicaPool:
     def probe_one(self, r: Replica) -> bool:
         """One health probe; returns True when the replica passed."""
         try:
+            # ``probe`` fault site: an injected exception is a failed
+            # probe (a flapping replica), a delay is probe latency
+            self.faults.check("probe")
             h = _http_json(f"{r.url}/healthz", timeout=self.probe_timeout)
             ok = bool(h.get("ok"))
         except Exception:  # noqa: BLE001 — refused/timeout/bad JSON all fail
             h, ok = None, False
+        fire_admit = None
         with self._lock:
             if not ok:
                 self._fail_locked(r)
@@ -195,6 +220,14 @@ class ReplicaPool:
                     r.consecutive_passes = 0
                     log_event(log, "replica readmitted", name=r.name,
                               pid=r.pid, restarts=r.restarts)
+            if self.on_admit is not None and r.routable and not r.warmed:
+                r.warmed = True
+                fire_admit = self.on_admit
+        if fire_admit is not None:
+            try:  # advisory (cache warming): never fail the probe over it
+                fire_admit(r)
+            except Exception:  # noqa: BLE001
+                pass
         return True
 
     def _fail_locked(self, r: Replica) -> None:
@@ -208,6 +241,7 @@ class ReplicaPool:
                 r.consecutive_fails >= self.fail_threshold:
             r.state = EJECTED
             r.ejections += 1
+            r.warmed = False  # its radix cache is gone; re-warm on readmit
             log_event(log, "replica ejected", name=r.name,
                       consecutive_fails=r.consecutive_fails)
 
@@ -291,9 +325,18 @@ class ReplicaPool:
 
     def begin_drain(self, name: str) -> None:
         """Mark a replica draining so the router stops sending BEFORE its
-        server starts 503ing new work."""
+        server starts 503ing new work. Managed replicas only: an
+        attached (unmanaged) replica has a probe-only lifecycle — this
+        pool cannot finish a drain it cannot restart, so marking one
+        DRAINING would just blackhole it until an operator noticed."""
         with self._lock:
-            self.replicas[name].state = DRAINING
+            r = self.replicas[name]
+            if not r.managed:
+                raise FleetError(
+                    f"replica {name!r} is attached (unmanaged): probe-only "
+                    f"lifecycle — it is ejected/readmitted on health, never "
+                    f"drained or restarted by this pool")
+            r.state = DRAINING
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -302,10 +345,19 @@ class ReplicaPool:
                         drain_grace: float = 10.0) -> None:
         """Restart every managed replica one at a time: drain via
         ``/shutdown``, redeploy on the SAME port, wait until it serves
-        again — the routable count never drops below ``live_floor``."""
+        again — the routable count never drops below ``live_floor``.
+        Attached (unmanaged) replicas are never touched: they keep
+        serving through the restart (and count toward the floor), and a
+        pool holding ONLY attached replicas raises a clear error
+        instead of an AttributeError on the runtime it never had."""
         managed = [r for r in self.replicas.values() if r.managed]
+        attached = sorted(r.name for r in self.replicas.values()
+                          if not r.managed and r.state != STOPPED)
         if not managed:
-            raise FleetError("no managed replicas to restart")
+            detail = (f"; {attached} are attached (unmanaged) with a "
+                      f"probe-only lifecycle — restart them where they "
+                      f"were deployed" if attached else "")
+            raise FleetError(f"no managed replicas to restart{detail}")
         if self.runtime is None:
             raise FleetError("pool has no LocalRuntime")
         if live_floor > len(managed) - 1 + \

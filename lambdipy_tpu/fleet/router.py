@@ -15,11 +15,22 @@ One listening port fronting N bundle-server replicas. Per request:
 3. **retry** — a dead connection or a sched-layer shed (429/503) retries
    on a DIFFERENT replica with jittered backoff; the backoff honors the
    shed's ``Retry-After`` (capped), and connection failures are reported
-   to the pool so a dead replica is ejected at traffic speed. When every
-   replica shed, the LAST shed response is relayed (with its
-   ``Retry-After``) instead of a synthetic error. Generate requests are
-   stateless, so retrying is always safe; a request is only
-   non-retryable once response bytes have reached the client.
+   to the pool so a dead replica is ejected at traffic speed. Retries
+   are governed by two resilience layers (fleet/breaker.py, both
+   optional): per-replica CIRCUIT BREAKERS (consecutive forward
+   failures or latency outliers open the breaker; after ``open_s`` one
+   half-open probe decides readmission — a partially-dead replica stops
+   eating retry attempts) and a fleet-wide RETRY BUDGET (re-sends
+   capped at a ratio of primary sends, so a fleet-wide failure is
+   relayed honestly instead of amplified into a retry storm). When
+   every replica shed, the LAST shed response is relayed (with its
+   ``Retry-After``) — unless the SPILL QUEUE (fleet/spill.py) is
+   enabled, in which case non-streamed requests park in a bounded
+   sched-backed queue and drain as replicas recover, shedding only on
+   queue overflow or deadline expiry (with the queue's own wait
+   estimate as ``Retry-After``). Generate requests are stateless, so
+   retrying is always safe; a request is only non-retryable once
+   response bytes have reached the client.
 4. **hedge** (optional) — a non-streamed request still unanswered after
    the hedge threshold (fixed ms, or ``"p95"`` = the router's own
    observed P95, floored) is duplicated on a second replica; the first
@@ -40,18 +51,24 @@ from __future__ import annotations
 
 import http.client
 import json
+import math
 import random
 import threading
 import time
 import urllib.error
 import urllib.request
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty, Queue
 
 from lambdipy_tpu.fleet import affinity
+from lambdipy_tpu.fleet.breaker import CircuitBreaker, RetryBudget
 from lambdipy_tpu.fleet.pool import Replica, ReplicaPool
+from lambdipy_tpu.fleet.spill import SPILL_DEADLINE, SpillQueue
 from lambdipy_tpu.runtime.deploy import _http_json
+from lambdipy_tpu.runtime.faults import FaultPlan, InjectedFault
 from lambdipy_tpu.runtime.metrics import RouterStats
+from lambdipy_tpu.sched.admission import Shed
 from lambdipy_tpu.utils.logs import get_logger, log_event
 
 log = get_logger("lambdipy.fleet.router")
@@ -67,7 +84,13 @@ class FleetRouter:
                  backoff_s: float = 0.05, backoff_cap_s: float = 2.0,
                  saturation: int = 8, hedge_ms: float | str = 0,
                  hedge_floor_ms: float = 50.0,
-                 request_timeout: float = 300.0):
+                 request_timeout: float = 300.0,
+                 spill_cap: int = 0, spill_max_wait_s: float = 30.0,
+                 breaker_fails: int = 0, breaker_open_s: float = 1.0,
+                 breaker_outlier_ms: float = 0.0,
+                 retry_budget: float = 0.0, retry_budget_min: int = 3,
+                 warm_prefixes: int = 4,
+                 faults: FaultPlan | None = None):
         self.pool = pool
         self.affinity_on = bool(affinity_on)
         self.block = max(1, int(block))
@@ -79,6 +102,34 @@ class FleetRouter:
         self.hedge_floor_ms = float(hedge_floor_ms)
         self.request_timeout = float(request_timeout)
         self.stats = RouterStats()
+        self.faults = faults or FaultPlan.empty()
+        # fleet-boundary resilience (all off by default at the library
+        # level so embedders opt in; `lambdipy fleet` turns them on)
+        self.spill: SpillQueue | None = None
+        if int(spill_cap) > 0:
+            self.spill = SpillQueue(
+                lambda: bool(self.pool.routable()
+                             or self.pool.live_fallback()),
+                capacity=int(spill_cap),
+                max_wait_s=float(spill_max_wait_s)).start()
+        self.breaker_fails = max(0, int(breaker_fails))
+        self.breaker_open_s = float(breaker_open_s)
+        self.breaker_outlier_ms = float(breaker_outlier_ms)
+        self.breakers: dict[str, CircuitBreaker] | None = \
+            {} if self.breaker_fails > 0 else None
+        self.retry_budget: RetryBudget | None = None
+        if float(retry_budget) > 0:
+            self.retry_budget = RetryBudget(ratio=float(retry_budget),
+                                            min_retries=retry_budget_min)
+        # hot-prefix tracker for affinity-aware cache warming: key ->
+        # {prompt, hits}, LRU-bounded; replayed into a replica when the
+        # pool (re)admits it
+        self.warm_prefixes = max(0, int(warm_prefixes))
+        self._hot: OrderedDict = OrderedDict()
+        self._hot_cap = max(8, 8 * self.warm_prefixes)
+        self._hot_lock = threading.Lock()
+        if self.warm_prefixes:
+            pool.on_admit = self._on_replica_admitted
         self._rr = 0  # tie-break rotation for least-outstanding picks
         self._rr_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
@@ -96,18 +147,53 @@ class FleetRouter:
         cands = cands[rot:] + cands[:rot]
         return min(cands, key=lambda r: r.outstanding)
 
+    def _breaker(self, r: Replica) -> CircuitBreaker | None:
+        if self.breakers is None:
+            return None
+        b = self.breakers.get(r.name)
+        if b is None:
+            b = self.breakers.setdefault(r.name, CircuitBreaker(
+                fail_threshold=self.breaker_fails,
+                open_s=self.breaker_open_s,
+                outlier_ms=self.breaker_outlier_ms,
+                # an unresolved probe (504 busy, gone stream client) is
+                # abandoned after the longest a forward can take
+                probe_grace_s=min(self.request_timeout, 60.0)))
+        return b
+
+    def _breaker_blocked(self, r: Replica) -> bool:
+        b = self._breaker(r)
+        return b is not None and b.blocked()
+
+    def _breaker_result(self, r: Replica, *, ok: bool,
+                        latency_ms: float | None = None) -> None:
+        b = self._breaker(r)
+        if b is None:
+            return
+        opens_before = b.opens
+        if ok:
+            b.record_success(latency_ms)
+        else:
+            b.record_failure()
+        if b.opens > opens_before:
+            log_event(log, "circuit breaker opened", replica=r.name,
+                      cause=b.last_cause)
+
     def _pick(self, key: bytes | None, exclude: set,
               *, count_affinity: bool) -> Replica | None:
-        cands = [r for r in self.pool.routable() if r.name not in exclude]
+        cands = [r for r in self.pool.routable()
+                 if r.name not in exclude and not self._breaker_blocked(r)]
         if not cands:
             # degrade to live-but-not-ready replicas (warm in flight /
             # server-side drain flag) rather than 503ing the fleet: a
             # warming replica serves fine, and a draining one sheds a
             # retryable 503 — both beat a synthetic no_replica
             cands = [r for r in self.pool.live_fallback()
-                     if r.name not in exclude]
+                     if r.name not in exclude
+                     and not self._breaker_blocked(r)]
         if not cands:
             return None
+        chosen: Replica
         if key is not None and self.affinity_on:
             target_name = affinity.pick_replica(
                 key, sorted(r.name for r in cands))
@@ -115,17 +201,24 @@ class FleetRouter:
             if target.outstanding >= self.saturation:
                 if count_affinity:
                     self.stats.count_affinity("saturated")
-                return self._least_outstanding(cands)
-            if count_affinity:
-                # "hit" only when the full-fleet rendezvous target was
-                # routable: a pick among survivors after an ejection is
-                # affinity-consistent but not a cache-affinity hit
-                all_names = sorted(self.pool.replicas)
-                full_target = affinity.pick_replica(key, all_names)
-                self.stats.count_affinity(
-                    "hit" if full_target == target_name else "ejected")
-            return target
-        return self._least_outstanding(cands)
+                chosen = self._least_outstanding(cands)
+            else:
+                if count_affinity:
+                    # "hit" only when the full-fleet rendezvous target
+                    # was routable: a pick among survivors after an
+                    # ejection is affinity-consistent but not a
+                    # cache-affinity hit
+                    all_names = sorted(self.pool.replicas)
+                    full_target = affinity.pick_replica(key, all_names)
+                    self.stats.count_affinity(
+                        "hit" if full_target == target_name else "ejected")
+                chosen = target
+        else:
+            chosen = self._least_outstanding(cands)
+        b = self._breaker(chosen)
+        if b is not None:
+            b.begin_attempt()  # claim the half-open probe slot if due
+        return chosen
 
     # -- forwarding ---------------------------------------------------------
 
@@ -140,17 +233,39 @@ class FleetRouter:
     def _forward(self, replica: Replica, path: str, data: bytes,
                  headers: dict) -> tuple[int, dict, bytes]:
         """POST to one replica; HTTP error statuses return as statuses,
-        connection-level failures raise."""
+        connection-level failures raise. Feeds the replica's circuit
+        breaker (a 503 shed is explicit backpressure, not a fault; a
+        timeout is a busy replica, not a dead one — neither counts as a
+        breaker failure) and the router-side fault sites."""
         req = urllib.request.Request(replica.url + path, data=data,
                                      headers=headers, method="POST")
         self.pool.acquire(replica)
+        t0 = time.monotonic()
         try:
+            # network chaos sites: a simulated latency spike, a dropped
+            # connection, and a connection dying mid-body (the body was
+            # read but never arrived intact)
+            self.faults.check("route_latency")
+            self.faults.check("route_connect")
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.request_timeout) as resp:
-                    return resp.status, dict(resp.headers), resp.read()
+                    out = resp.status, dict(resp.headers), resp.read()
             except urllib.error.HTTPError as e:
-                return e.code, dict(e.headers), e.read()
+                out = e.code, dict(e.headers), e.read()
+            self.faults.check("route_body")
+            ok = out[0] < 500 or out[0] == 503
+            self._breaker_result(
+                replica, ok=ok,
+                latency_ms=(time.monotonic() - t0) * 1e3 if ok else None)
+            return out
+        except Exception as e:  # noqa: BLE001 — classify for the breaker
+            # (HTTPError cannot reach here — the inner except converts
+            # it to a status tuple; only connection-level failures and
+            # injected faults do)
+            if not self._is_timeout(e):
+                self._breaker_result(replica, ok=False)
+            raise
         finally:
             self.pool.release(replica)
 
@@ -206,7 +321,93 @@ class FleetRouter:
             return max(self.hedge_floor_ms, p95) / 1e3
         return max(float(self.hedge_ms), self.hedge_floor_ms) / 1e3
 
+    # -- affinity-aware cache warming ---------------------------------------
+
+    def _note_hot_prefix(self, key: bytes, body: dict) -> None:
+        """Track the fleet's hottest affinity prefixes (LRU + hit
+        count) so a readmitted or freshly attached replica can be
+        warmed with the prefixes the rendezvous hash will send it."""
+        if not self.warm_prefixes:
+            return
+        with self._hot_lock:
+            entry = self._hot.get(key)
+            if entry is not None:
+                entry["hits"] += 1
+                self._hot.move_to_end(key)
+                return
+        prompt = affinity.warm_prompt(body, block=self.block)
+        if prompt is None:
+            return  # sub-block prompt: nothing the radix store caches
+        with self._hot_lock:
+            if key not in self._hot:
+                self._hot[key] = {"prompt": prompt, "hits": 1}
+                while len(self._hot) > self._hot_cap:
+                    self._hot.popitem(last=False)
+
+    def _on_replica_admitted(self, replica: Replica) -> None:
+        """Pool hook: a replica just became routable (first probe after
+        attach/spawn, or readmission after an ejection). Warm it in the
+        background — the prober thread must not block on prefills."""
+        threading.Thread(target=self._warm_replica, args=(replica,),
+                         daemon=True,
+                         name=f"fleet-warm-{replica.name}").start()
+
+    def _warm_replica(self, replica: Replica) -> None:
+        """Replay this replica's share of the fleet's hottest prefixes
+        (the keys the FULL-membership rendezvous hash assigns to it)
+        as background-class 1-token generations: the prefill IS the
+        radix-cache insertion, so the next real request on the warmed
+        prefix longest-prefix-matches instead of paying a cold
+        prefill."""
+        with self._hot_lock:
+            items = [(k, e["hits"], e["prompt"])
+                     for k, e in self._hot.items()]
+        if not items:
+            return
+        names = sorted(self.pool.replicas)
+        mine = [(hits, prompt) for k, hits, prompt in items
+                if affinity.pick_replica(k, names) == replica.name]
+        mine.sort(key=lambda t: -t[0])
+        for _, prompt in mine[: self.warm_prefixes]:
+            body = json.dumps({"prompt": prompt, "max_tokens": 1,
+                               "temperature": 0}).encode()
+            req = urllib.request.Request(
+                replica.url + "/v1/completions", data=body,
+                headers={"Content-Type": "application/json",
+                         "x-priority": "background"}, method="POST")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.request_timeout) as resp:
+                    resp.read()
+                self.stats.count("warmed_prefixes")
+            except Exception as e:  # noqa: BLE001 — warming is advisory
+                log_event(log, "cache warm failed", replica=replica.name,
+                          error=str(e))
+                return  # an unhealthy target: stop, health owns it now
+
     # -- request routing ----------------------------------------------------
+
+    def _spend_retry(self) -> bool:
+        """Charge one retry against the fleet-wide budget (always true
+        when the budget is disabled)."""
+        if self.retry_budget is None or self.retry_budget.allow_retry():
+            return True
+        self.stats.count("retry_budget_denied")
+        return False
+
+    @staticmethod
+    def _sched_identity(headers) -> tuple[str, str, float | None]:
+        """(class, tenant, deadline_ms) from the sched headers — the
+        spill queue parks by the same identity the server-side queue
+        would have used."""
+        cls = (headers.get("x-priority") or "interactive").strip().lower()
+        tenant = (headers.get("x-api-key") or headers.get("x-tenant")
+                  or "anon")
+        try:
+            deadline_ms = float(headers["x-deadline-ms"])
+        except (KeyError, TypeError, ValueError):
+            deadline_ms = None
+        return cls, tenant, deadline_ms
 
     def _route(self, handler, path: str, body: dict, raw: bytes) -> None:
         openai = path == "/v1/completions"
@@ -214,14 +415,91 @@ class FleetRouter:
                if self.affinity_on else None)
         headers = self._fwd_headers(handler.headers)
         self.stats.count("requests")
+        if key is not None:
+            self._note_hot_prefix(key, body)
+        if self.retry_budget is not None:
+            # streams fund the budget too — they spend it on their
+            # pre-first-byte retries, and an unfunded stream-heavy
+            # workload would starve everyone down to the min floor
+            self.retry_budget.record_request()
         if body.get("stream"):
             self._route_stream(handler, path, raw, headers, key)
             return
         t0 = time.monotonic()
+        res = self._attempt(handler, path, raw, headers, key, t0,
+                            count_affinity=True)
+        if res is None:
+            return  # response already on the wire
+        # the fleet is exhausted (every attempt shed, or nothing was
+        # routable). With the spill queue enabled, park non-streamed
+        # requests and drain them as replicas recover — a transient
+        # fleet-wide brownout should cost queue wait, not client errors.
+        if self.spill is not None:
+            cls, tenant, deadline_ms = self._sched_identity(handler.headers)
+            spill_deadline = t0 + self.spill.max_wait_s
+            if deadline_ms is not None:
+                spill_deadline = min(spill_deadline, t0 + deadline_ms / 1e3)
+            self.stats.count("spilled")
+            while True:
+                last_shed = res if isinstance(res, tuple) else None
+                hint = (self._retry_after_s(*last_shed)
+                        if last_shed else 0.0)
+                outcome = self.spill.park(
+                    cls=cls, tenant=tenant,
+                    wait_s=spill_deadline - time.monotonic(), hint_s=hint)
+                if isinstance(outcome, Shed):
+                    self.stats.count(
+                        "spill_expired" if outcome.reason == SPILL_DEADLINE
+                        else "spill_overflow")
+                    self._send_spill_shed(handler, outcome, openai)
+                    return
+                self.stats.count("spill_drained")
+                try:
+                    res = self._attempt(handler, path, raw, headers, key,
+                                        t0, count_affinity=False)
+                finally:
+                    self.spill.done(outcome)
+                if res is None:
+                    return
+        if isinstance(res, tuple):
+            status, hdrs, out = res
+            handler.relay(status, hdrs, out)
+            return
+        self.stats.count("no_replica")
+        self.stats.count("errors")
+        payload = {"error": {"message": "no routable replicas",
+                             "type": "overloaded_error"}} if openai else \
+            {"ok": False, "shed": True, "reason": "no_replica",
+             "retry_after_s": 1.0}
+        handler.send(503, payload, {"Retry-After": "1"})
+
+    def _send_spill_shed(self, handler, shed: Shed, openai: bool) -> None:
+        """The spill queue's own shed: same wire contract as the
+        server-side admission layer (integer ``Retry-After`` header per
+        RFC 9110, exact ``retry_after_s`` float in the body — the shape
+        :meth:`_retry_after_s` itself parses), priced by the queue's
+        wait estimate."""
+        self.stats.count("errors")
+        hdrs = {"Retry-After": str(max(1, math.ceil(shed.retry_after_s)))}
+        if openai:
+            payload = {"error": {
+                "message": f"shed: {shed.reason}",
+                "type": "overloaded_error",
+                "retry_after_s": round(shed.retry_after_s, 3)}}
+        else:
+            payload = shed.payload()
+        handler.send(shed.code, payload, hdrs)
+
+    def _attempt(self, handler, path: str, raw: bytes, headers: dict,
+                 key: bytes | None, t0: float, *, count_affinity: bool):
+        """One retry round over the fleet. Returns None when a response
+        was sent to the client, the last shed ``(status, hdrs, body)``
+        tuple when every attempt shed, or ``"no_replica"`` when nothing
+        was routable."""
         tried: set = set()
         last_shed: tuple | None = None
         attempt = 0
-        first = True
+        first = count_affinity
         while attempt <= self.max_retries:
             r = self._pick(key, tried, count_affinity=first)
             if r is None:
@@ -243,7 +521,7 @@ class FleetRouter:
                     handler.send(504, {"ok": False,
                                        "error": "upstream timeout",
                                        "replica": r.name})
-                    return
+                    return None
                 self.pool.note_failure(r)
                 self.stats.count("failovers")
                 self.stats.count("retries")
@@ -255,6 +533,8 @@ class FleetRouter:
                           error=str(e))
                 if attempt > self.max_retries:
                     break  # exhausted: no point sleeping before the 503
+                if not self._spend_retry():
+                    break  # retry budget spent: stop amplifying
                 self._backoff(attempt, 0.0, others_available=bool(
                     [x for x in self.pool.routable()
                      if x.name not in tried]))
@@ -267,6 +547,8 @@ class FleetRouter:
                 attempt += 1
                 if attempt > self.max_retries:
                     break
+                if not self._spend_retry():
+                    break  # relay the shed honestly instead of storming
                 self.stats.count("retries")
                 self.pool.bump(r, "retried")
                 others = [x for x in self.pool.routable()
@@ -283,18 +565,8 @@ class FleetRouter:
                 self.stats.count("completed")
                 self.stats.latency.record((time.monotonic() - t0) * 1e3)
             handler.relay(status, hdrs, out)
-            return
-        if last_shed is not None:
-            status, hdrs, out = last_shed
-            handler.relay(status, hdrs, out)
-            return
-        self.stats.count("no_replica")
-        self.stats.count("errors")
-        payload = {"error": {"message": "no routable replicas",
-                             "type": "overloaded_error"}} if openai else \
-            {"ok": False, "shed": True, "reason": "no_replica",
-             "retry_after_s": 1.0}
-        handler.send(503, payload, {"Retry-After": "1"})
+            return None
+        return last_shed if last_shed is not None else "no_replica"
 
     def _forward_hedged(self, primary: Replica, path: str, raw: bytes,
                         headers: dict, hedge_s: float, tried: set,
@@ -374,10 +646,17 @@ class FleetRouter:
             resp = None
             try:
                 try:
+                    self.faults.check("route_latency")
+                    self.faults.check("route_connect")
                     resp = urllib.request.urlopen(
                         req, timeout=self.request_timeout)
                 except urllib.error.HTTPError as e:
                     body = e.read()
+                    # the replica ANSWERED: resolve a half-open probe
+                    # (a shed is backpressure, not a fault; no latency
+                    # sample — see the stream-completion note below)
+                    self._breaker_result(r, ok=e.code < 500
+                                         or e.code == 503)
                     if e.code in (429, 503):
                         # same shed contract as the non-streamed path:
                         # jittered backoff honoring Retry-After, rotate
@@ -387,6 +666,8 @@ class FleetRouter:
                         if attempt >= self.max_retries:
                             break  # out of attempts: relay the shed
                             #        now, don't sleep first
+                        if not self._spend_retry():
+                            break
                         self.stats.count("retries")
                         self.pool.bump(r, "retried")
                         hint = self._retry_after_s(e.code, dict(e.headers),
@@ -410,6 +691,7 @@ class FleetRouter:
                                            "error": "upstream timeout",
                                            "replica": r.name})
                         return
+                    self._breaker_result(r, ok=False)
                     self.pool.note_failure(r)
                     self.stats.count("failovers")
                     self.stats.count("retries")
@@ -417,6 +699,8 @@ class FleetRouter:
                     tried.add(r.name)
                     log_event(log, "stream open failed, retrying",
                               replica=r.name, error=str(e))
+                    if not self._spend_retry():
+                        break
                     continue
                 self.pool.bump(r, "routed")
                 handler.send_response(200)
@@ -427,20 +711,29 @@ class FleetRouter:
                 handler.end_headers()
                 try:
                     for line in resp:  # urllib de-chunks; line-framed body
+                        self.faults.check("route_body")
                         if not handler.write_frame(line):
-                            return  # client went away
-                except (OSError, http.client.HTTPException):
+                            # client went away — the REPLICA is healthy,
+                            # so a half-open probe must still resolve
+                            self._breaker_result(r, ok=True)
+                            return
+                except (OSError, http.client.HTTPException, InjectedFault):
                     # replica died mid-stream (FIN -> IncompleteRead,
                     # RST -> ConnectionReset). The headers are committed,
                     # so the only honest signal left is an UNTERMINATED
                     # chunked body — writing the terminal chunk would
                     # make the client's HTTP layer report the truncated
                     # output as complete.
+                    self._breaker_result(r, ok=False)
                     self.pool.note_failure(r)
                     self.stats.count("errors")
                     handler.close_connection = True
                     return
                 handler.end_frames()
+                # no latency sample: a stream's duration is the decode
+                # length, not replica health — it must not trip the
+                # latency-outlier breaker
+                self._breaker_result(r, ok=True)
                 self.stats.count("completed")
                 self.stats.latency.record((time.monotonic() - t0) * 1e3)
                 return
@@ -493,8 +786,20 @@ class FleetRouter:
                     agg[k] += int(pc.get(k, 0))
         total = agg["hits"] + agg["misses"]
         routable = self.pool.routable()
+        router_rep = self.stats.report()
+        if self.spill is not None:
+            # live gauges (depth, wait percentiles, drain estimate)
+            # ride on the stats counters the spill path bumps
+            router_rep["spill"] = {**router_rep["spill"],
+                                   **self.spill.report()}
+        if self.breakers is not None:
+            router_rep["breakers"] = {
+                name: b.report()
+                for name, b in sorted(self.breakers.items())}
+        if self.retry_budget is not None:
+            router_rep["retry_budget"] = self.retry_budget.report()
         return {
-            "router": self.stats.report(),
+            "router": router_rep,
             "pool": self.pool.report(),
             "fleet": {
                 "replicas": len(self.pool.replicas),
@@ -583,6 +888,8 @@ class FleetRouter:
                         # serve) — the fleet-level view of the per-
                         # replica /healthz wedged flag
                         **({"wedged": wedged} if wedged else {}),
+                        **({"spill_depth": router_self.spill.depth()}
+                           if router_self.spill is not None else {}),
                         "affinity": router_self.affinity_on,
                         "block": router_self.block,
                     })
@@ -624,5 +931,7 @@ class FleetRouter:
         return self
 
     def stop(self) -> None:
+        if self.spill is not None:
+            self.spill.close()  # wake parked client threads first
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -14,7 +14,20 @@ site                      where it fires
 ``group_prefill``         the engine's ragged b-row joiner prefill
 ``prefix_assemble``       continue-prefill from a cached prefix KV
 ``transport``             the ``block_until_ready`` device wait before fetch
+``route_connect``         the fleet router opening a replica connection
+``route_body``            the router reading a replica response body
+``route_latency``         the router's forward path (network latency site)
+``probe``                 the replica pool's per-replica health probe
 ========================  ====================================================
+
+The ``route_*``/``probe`` sites live in the FLEET layer (fleet/router.py
+and fleet/pool.py): they make the *network* lie — dropped connections
+(``route_connect:exception``), connections dying mid-body
+(``route_body:exception``), latency spikes
+(``route_latency:delay@ms=300``), and flapping replicas
+(``probe:exception@seg=3,n=6``) — so ``bench.py --chaos-fleet`` can run
+a drop/latency/flap matrix against a live fleet with the same
+deterministic call counting the engine sites get.
 
 Each site can raise (``exception``), stall (``delay``, ``ms=``) or block
 indefinitely (``hang`` — until the plan is released, the watchdog aborts
@@ -44,7 +57,9 @@ import time
 from dataclasses import dataclass, field
 
 SITES = ("segment_dispatch", "segment_fetch", "group_prefill",
-         "prefix_assemble", "transport")
+         "prefix_assemble", "transport",
+         # fleet-layer (router/pool) network sites
+         "route_connect", "route_body", "route_latency", "probe")
 KINDS = ("exception", "delay", "hang")
 _KIND_ALIASES = {"error": "exception", "raise": "exception",
                  "sleep": "delay", "stall": "delay", "block": "hang"}
@@ -156,8 +171,13 @@ class FaultPlan:
         return cls(rules)
 
     @classmethod
-    def from_env(cls, environ=None) -> "FaultPlan":
-        return cls.from_spec((environ or os.environ).get("LAMBDIPY_FAULT"))
+    def from_env(cls, environ=None, *, var: str = "LAMBDIPY_FAULT"
+                 ) -> "FaultPlan":
+        """``var`` selects the env knob: the engine reads
+        ``LAMBDIPY_FAULT``; the fleet layer reads
+        ``LAMBDIPY_FLEET_FAULT`` so arming a replica's engine sites
+        never silently arms the router in the same shell."""
+        return cls.from_spec((environ or os.environ).get(var))
 
     # -- the injection point -------------------------------------------------
 

@@ -298,7 +298,17 @@ class RouterStats:
     counters measure prefix-affinity routing: a hit means the request
     reached its rendezvous-hash target; fallbacks record why it did not
     (target ejected/busy). ``latency`` is the router-observed end-to-end
-    distribution — the P9x basis for the hedging threshold."""
+    distribution — the P9x basis for the hedging threshold.
+
+    The ``spill_*`` counters track the router's fleet-wide-overload
+    parking lot (fleet/spill.py): ``spilled`` = requests parked at
+    least once, ``spill_drained`` = grants back into the retry loop,
+    ``spill_expired``/``spill_overflow`` = the queue's own sheds (the
+    live depth/wait gauges ride on the spill queue's report in the
+    router ``/metrics``). ``retry_budget_denied`` counts re-sends the
+    fleet-wide retry budget refused; ``warmed_prefixes`` counts hot
+    radix prefixes replayed into a readmitted/attached replica's
+    cache."""
 
     requests: int = 0
     completed: int = 0
@@ -308,6 +318,12 @@ class RouterStats:
     hedges: int = 0
     hedge_wins: int = 0
     no_replica: int = 0
+    spilled: int = 0
+    spill_drained: int = 0
+    spill_expired: int = 0
+    spill_overflow: int = 0
+    retry_budget_denied: int = 0
+    warmed_prefixes: int = 0
     affinity_requests: int = 0
     affinity_hits: int = 0
     affinity_fallbacks: dict = field(default_factory=dict)  # reason -> n
@@ -341,6 +357,14 @@ class RouterStats:
                 "hedges": self.hedges,
                 "hedge_wins": self.hedge_wins,
                 "no_replica": self.no_replica,
+                "spill": {
+                    "spilled": self.spilled,
+                    "drained": self.spill_drained,
+                    "expired": self.spill_expired,
+                    "overflow": self.spill_overflow,
+                },
+                "retry_budget_denied": self.retry_budget_denied,
+                "warmed_prefixes": self.warmed_prefixes,
                 "affinity": {
                     "requests": self.affinity_requests,
                     "hits": self.affinity_hits,

@@ -28,7 +28,11 @@
 # restart — the slow tests in tests/test_fleet.py); phase 7 the CHAOS
 # matrix (bench.py --chaos: every runtime/faults.py site x {exception,
 # delay, hang} injected into a live continuous engine — no waiter
-# outlives its bound, zero silent losses, replay parity is bitwise).
+# outlives its bound, zero silent losses, replay parity is bitwise);
+# phase 8 the FLEET-BOUNDARY chaos matrix (bench.py --chaos-fleet:
+# router-side network faults — dropped connections, mid-body deaths,
+# latency spikes, flapping probes — plus a fleet-wide shed burst the
+# router's spill queue must absorb with zero client-visible errors).
 #
 # Every phase prints its wall-clock so the budget breakdown is visible
 # in the log (ROADMAP open item: phase 2 runs close to its 870 s cap).
@@ -139,4 +143,18 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 7"
+
+# Phase 8: fleet-boundary chaos — bench.py --chaos-fleet boots a live
+# 2-replica CPU fleet behind the resilient router and runs the
+# drop/latency/mid-body/flap matrix plus a fleet-wide shed burst,
+# exiting nonzero on any silent loss, unbounded tail, failed flap
+# recovery, or a burst the spill queue failed to absorb. Budgeted like
+# the phase-2 shards (same 870 s ceiling); its wall-clock prints below.
+phase_begin "phase 8: fleet chaos matrix (bench.py --chaos-fleet)"
+if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python bench.py --chaos-fleet; then
+    echo "FATAL: bench.py --chaos-fleet matrix failed" >&2
+    exit 1
+fi
+phase_end "phase 8"
 exit 0

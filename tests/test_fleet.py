@@ -131,6 +131,7 @@ class StubReplica:
                     "prefix_cache": {"hits": 0, "misses": 0,
                                      "hit_tokens": 0}}
         self.invokes = 0
+        self.bodies = []  # (path, parsed body) of every POST received
         stub = self
 
         class H(BaseHTTPRequestHandler):
@@ -171,6 +172,7 @@ class StubReplica:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
+                stub.bodies.append((self.path, body))
                 if stub.cfg["delay_s"]:
                     time.sleep(stub.cfg["delay_s"])
                 if stub.cfg["shed"] or stub.cfg["draining"]:
@@ -501,9 +503,13 @@ def test_router_serves_through_whole_fleet_warming(stub_pair):
 
 def test_pool_begin_drain_routes_away_immediately(stub_pair):
     """Rolling-drain step 1: begin_drain() flips routing away without
-    waiting for the next probe."""
+    waiting for the next probe. (The stubs stand in for MANAGED
+    replicas here — begin_drain refuses attached ones, see
+    tests/test_fleet_resilience.py.)"""
     s0, s1, pool = stub_pair
     pool.probe_all()
+    for r in pool.replicas.values():
+        r.managed = True
     router = FleetRouter(pool, affinity_on=False)
     router.start_background()
     base = f"http://127.0.0.1:{router.port}"

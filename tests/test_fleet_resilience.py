@@ -1,0 +1,694 @@
+"""Fleet-boundary resilience: circuit breakers, the fleet-wide retry
+budget, the router spill queue, router-side network fault injection,
+and first-class attached (unmanaged) replicas. All on scriptable stub
+replicas — no device, no bundle boot — so the whole module stays in the
+fast tier-1 budget; the live-fleet end-to-end matrix is
+``bench.py --chaos-fleet`` (run_tier1.sh phase 8)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lambdipy_tpu.fleet import (
+    EJECTED,
+    READY,
+    CircuitBreaker,
+    FleetError,
+    FleetRouter,
+    ReplicaPool,
+    RetryBudget,
+    SpillQueue,
+    affinity,
+)
+from lambdipy_tpu.fleet.breaker import CLOSED, HALF_OPEN, OPEN
+from lambdipy_tpu.runtime.faults import FaultPlan
+from lambdipy_tpu.sched.admission import Shed
+
+from test_fleet import StubReplica, _get, _post
+
+
+@pytest.fixture()
+def stub_pair():
+    s0, s1 = StubReplica("r0"), StubReplica("r1")
+    pool = ReplicaPool(probe_interval=0.1, fail_threshold=1,
+                      readmit_passes=2, probe_timeout=2.0)
+    pool.attach("r0", s0.url)
+    pool.attach("r1", s1.url)
+    yield s0, s1, pool
+    pool.close()
+    for s in (s0, s1):
+        try:
+            s.kill()
+        except Exception:
+            pass
+
+
+# -- circuit breaker state machine (pure, fake clock) ------------------------
+
+
+def test_breaker_transitions_closed_open_half_open_closed():
+    t = [100.0]
+    b = CircuitBreaker(fail_threshold=3, open_s=1.0, clock=lambda: t[0])
+    assert b.state == CLOSED and not b.blocked()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED  # under threshold
+    b.record_failure()
+    assert b.state == OPEN and b.blocked() and b.opens == 1
+    assert b.last_cause == "consecutive_failures"
+    # the open interval must elapse before a probe is allowed
+    t[0] += 0.5
+    assert b.blocked()
+    t[0] += 0.6
+    assert not b.blocked()
+    b.begin_attempt()  # the router picked it: half-open probe in flight
+    assert b.state == HALF_OPEN and b.half_open_probes == 1
+    assert b.blocked()  # a second pick must not double-probe
+    b.record_success()
+    assert b.state == CLOSED and b.closes == 1 and not b.blocked()
+    # a success resets the consecutive count entirely
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED
+
+
+def test_breaker_half_open_failure_reopens_with_backoff():
+    t = [0.0]
+    b = CircuitBreaker(fail_threshold=1, open_s=1.0, max_open_s=3.0,
+                       clock=lambda: t[0])
+    b.record_failure()
+    assert b.state == OPEN and b.open_until == pytest.approx(1.0)
+    t[0] = 1.5
+    b.begin_attempt()
+    b.record_failure()  # the probe failed: reopen, interval doubled
+    assert b.state == OPEN and b.opens == 2
+    assert b.open_until == pytest.approx(1.5 + 2.0)
+    assert b.last_cause == "half_open_probe_failed"
+    t[0] = 4.0
+    b.begin_attempt()
+    b.record_failure()  # doubled again but capped at max_open_s
+    assert b.open_until == pytest.approx(4.0 + 3.0)
+    t[0] = 8.0
+    b.begin_attempt()
+    b.record_success()  # close resets the backoff ladder
+    b.record_failure()
+    assert b.open_until == pytest.approx(8.0 + 1.0)
+
+
+def test_breaker_abandoned_half_open_probe_reclaims_after_grace():
+    """Some router paths never resolve their forward (a 504
+    busy-not-dead timeout, a streamed client that went away): an
+    unresolved half-open probe must not blackhole the replica forever —
+    after ``probe_grace_s`` the slot can be re-claimed, and the next
+    resolved probe decides."""
+    t = [0.0]
+    b = CircuitBreaker(fail_threshold=1, open_s=1.0, probe_grace_s=5.0,
+                       clock=lambda: t[0])
+    b.record_failure()
+    t[0] = 1.5
+    b.begin_attempt()  # probe 1 claimed... and never resolved
+    assert b.state == HALF_OPEN and b.blocked()
+    t[0] = 4.0
+    assert b.blocked()  # within grace: still one probe in flight
+    t[0] = 7.0          # past 1.5 + 5.0: probe 1 is abandoned
+    assert not b.blocked()
+    b.begin_attempt()
+    assert b.half_open_probes == 2
+    assert b.blocked()  # probe 2 now owns the slot
+    b.record_success()
+    assert b.state == CLOSED and not b.blocked()
+
+
+def test_breaker_latency_outlier_opens():
+    t = [0.0]
+    b = CircuitBreaker(fail_threshold=5, open_s=1.0, outlier_ms=100.0,
+                       outlier_threshold=3, clock=lambda: t[0])
+    for _ in range(2):
+        b.record_success(latency_ms=500.0)
+    assert b.state == CLOSED
+    b.record_success(latency_ms=50.0)  # a fast answer resets the streak
+    b.record_success(latency_ms=500.0)
+    b.record_success(latency_ms=500.0)
+    assert b.state == CLOSED
+    b.record_success(latency_ms=500.0)
+    assert b.state == OPEN and b.last_cause == "latency_outlier"
+
+
+def test_retry_budget_ratio_floor_and_window():
+    t = [0.0]
+    rb = RetryBudget(ratio=0.5, min_retries=1, window_s=10.0,
+                     clock=lambda: t[0])
+    # floor: with zero primaries, exactly min_retries retries pass
+    assert rb.allow_retry()
+    assert not rb.allow_retry()
+    assert rb.denied == 1
+    # primaries buy more retries at the ratio
+    for _ in range(4):
+        rb.record_request()
+    assert rb.allow_retry()      # budget = 1 + 0.5*4 = 3 > 1 used
+    assert rb.allow_retry()
+    assert not rb.allow_retry()  # 3 >= 3
+    # the window slides: old entries stop counting against the budget
+    t[0] = 11.0
+    rb.record_request()
+    assert rb.allow_retry()
+    rep = rb.report()
+    assert rep["window_primaries"] == 1 and rep["window_retries"] == 1
+    assert rep["denied"] == 2
+
+
+def test_retry_budget_disabled_ratio_zero():
+    rb = RetryBudget(ratio=0.0, min_retries=0)
+    assert all(rb.allow_retry() for _ in range(20))
+    assert rb.denied == 0
+
+
+# -- spill queue (pure) ------------------------------------------------------
+
+
+def test_spill_queue_grants_in_policy_order_when_ready():
+    ready = [False]
+    q = SpillQueue(lambda: ready[0], capacity=8, max_wait_s=5.0,
+                   poll_s=0.01, max_inflight=1).start()
+    order = []
+
+    def park(cls):
+        out = q.park(cls=cls)
+        assert not isinstance(out, Shed)
+        order.append(cls)
+        time.sleep(0.05)
+        q.done(out)
+
+    try:
+        threads = [threading.Thread(target=park, args=("background",)),
+                   threading.Thread(target=park, args=("interactive",))]
+        threads[0].start()
+        time.sleep(0.1)  # background parks first...
+        threads[1].start()
+        time.sleep(0.1)
+        assert q.depth() == 2 and order == []  # nothing ready: all parked
+        ready[0] = True
+        for th in threads:
+            th.join(timeout=5)
+        # ...but the priority policy drains interactive first
+        assert order == ["interactive", "background"]
+        rep = q.report()
+        assert rep["parked"] == 2 and rep["granted"] == 2
+        assert rep["wait"]["count"] == 2
+    finally:
+        q.close()
+
+
+def test_spill_queue_overflow_and_deadline_shed_with_estimate():
+    q = SpillQueue(lambda: False, capacity=1, max_wait_s=0.3,
+                   poll_s=0.01).start()
+    try:
+        results = []
+        th = threading.Thread(
+            target=lambda: results.append(q.park(cls="interactive")))
+        th.start()
+        time.sleep(0.1)
+        # capacity 1 is taken: the second park overflows IMMEDIATELY,
+        # priced with the queue's wait estimate
+        out = q.park(cls="interactive")
+        assert isinstance(out, Shed) and out.reason == "spill_overflow"
+        assert out.code == 503 and out.retry_after_s > 0
+        th.join(timeout=5)
+        # the parked one expired at the deadline (never ready)
+        assert isinstance(results[0], Shed)
+        assert results[0].reason == "spill_deadline"
+        assert results[0].retry_after_s > 0
+        rep = q.report()
+        assert rep["expired"] == 1 and rep["overflow"] == 1
+        assert rep["depth"] == 0  # expired tickets leave the queue
+    finally:
+        q.close()
+
+
+def test_spill_queue_respects_caller_wait_bound():
+    q = SpillQueue(lambda: False, capacity=4, max_wait_s=30.0,
+                   poll_s=0.01).start()
+    try:
+        t0 = time.monotonic()
+        out = q.park(cls="interactive", wait_s=0.2)
+        assert isinstance(out, Shed) and out.reason == "spill_deadline"
+        assert time.monotonic() - t0 < 2.0
+        assert isinstance(q.park(cls="interactive", wait_s=-1.0), Shed)
+    finally:
+        q.close()
+
+
+# -- router: spill absorption ------------------------------------------------
+
+
+def test_router_spill_absorbs_transient_fleet_wide_shed(stub_pair):
+    """The tentpole claim: a transient fleet-wide shed burst completes
+    with ZERO client-visible 429/503s when queue capacity suffices —
+    the router parks the burst and drains it on recovery."""
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    s0.cfg["shed"] = s1.cfg["shed"] = True
+    router = FleetRouter(pool, affinity_on=False, max_retries=1,
+                         backoff_s=0.01, backoff_cap_s=0.05,
+                         spill_cap=16, spill_max_wait_s=10.0)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    results, errors = [], []
+
+    def one(i):
+        try:
+            results.append(_post(f"{base}/invoke", {"tokens": [i]}))
+        except Exception as e:  # noqa: BLE001 — collected for assert
+            errors.append(repr(e))
+
+    try:
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # the burst is parked, not shed
+        assert not errors and not results
+        s0.cfg["shed"] = s1.cfg["shed"] = False  # fleet recovers
+        for t in threads:
+            t.join(timeout=15)
+        assert not errors, f"client-visible errors: {errors[:3]}"
+        assert len(results) == 4 and all(r["ok"] for r in results)
+        rep = router.stats.report()
+        assert rep["spill"]["spilled"] == 4
+        assert rep["spill"]["drained"] >= 4
+        assert rep["spill"]["expired"] == 0
+        assert router.metrics()["router"]["spill"]["wait"]["count"] >= 4
+    finally:
+        router.stop()
+
+
+def test_router_spill_deadline_sheds_with_wait_estimate(stub_pair):
+    """Satellite: when the spill queue itself sheds, the response
+    carries the queue's OWN wait estimate in the same wire format the
+    server-side shed uses (integer Retry-After header + exact float
+    retry_after_s in the body) — the shape the router's own
+    ``_retry_after_s`` parses."""
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    s0.cfg["shed"] = s1.cfg["shed"] = True  # and they never recover
+    router = FleetRouter(pool, affinity_on=False, max_retries=1,
+                         backoff_s=0.01, backoff_cap_s=0.05,
+                         spill_cap=8, spill_max_wait_s=0.5)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/invoke", {"tokens": [1]})
+        assert e.value.code == 503
+        assert int(e.value.headers["Retry-After"]) >= 1
+        body = json.loads(e.value.read())
+        assert body["shed"] == "spill_deadline"
+        assert body["retry_after_s"] > 0
+        # the relayed format round-trips through the router's parser
+        assert FleetRouter._retry_after_s(
+            503, {}, json.dumps(body).encode()) == body["retry_after_s"]
+        assert router.stats.report()["spill"]["expired"] == 1
+
+        # the OpenAI surface sheds in the OpenAI error shape
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/v1/completions", {"prompt": [1]})
+        err = json.loads(e.value.read())["error"]
+        assert err["type"] == "overloaded_error"
+        assert err["retry_after_s"] > 0
+    finally:
+        router.stop()
+
+
+def test_router_spill_overflow_sheds_excess(stub_pair):
+    """With the whole fleet EJECTED (nothing routable, nothing to grant
+    onto), a burst past the queue capacity overflows immediately —
+    bounded queue, explicit sheds — while the one parked request drains
+    once a replica is revived and readmitted."""
+    s0, s1, pool = stub_pair
+    pool.start()
+    port0 = s0.port
+    s0.kill()
+    s1.kill()
+    pool.probe_all()
+    assert all(r.state == EJECTED for r in pool.replicas.values())
+    router = FleetRouter(pool, affinity_on=False, max_retries=0,
+                         backoff_s=0.01, spill_cap=1,
+                         spill_max_wait_s=15.0)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    outcomes = []
+    s0b = None
+
+    def one(i):
+        try:
+            outcomes.append(("ok", _post(f"{base}/invoke", {"tokens": [i]})))
+        except urllib.error.HTTPError as e:
+            outcomes.append(("shed", json.loads(e.read())))
+
+    try:
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # 1 parked; the others must have overflowed
+        overflowed = [o for kind, o in outcomes if kind == "shed"]
+        assert len(overflowed) == 2
+        assert all(o["shed"] == "spill_overflow" and o["retry_after_s"] > 0
+                   for o in overflowed)
+        s0b = StubReplica("r0", port=port0)  # revive -> readmit -> drain
+        for t in threads:
+            t.join(timeout=15)
+        served = [o for kind, o in outcomes if kind == "ok"]
+        assert len(served) == 1 and served[0]["ok"]
+        rep = router.stats.report()["spill"]
+        assert rep["overflow"] == 2 and rep["spilled"] == 3
+        assert rep["drained"] >= 1
+    finally:
+        router.stop()
+        if s0b is not None:
+            s0b.kill()
+
+
+def test_router_streams_never_spill(stub_pair):
+    """A parked stream would hold a socket open with nothing honest to
+    send: streamed requests relay the fleet-wide shed immediately."""
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    s0.cfg["shed"] = s1.cfg["shed"] = True
+    router = FleetRouter(pool, affinity_on=False, max_retries=1,
+                         backoff_s=0.01, backoff_cap_s=0.05,
+                         spill_cap=8, spill_max_wait_s=30.0)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/invoke", {"tokens": [1], "stream": True})
+        assert e.value.code == 503
+        assert time.monotonic() - t0 < 5.0  # did not park for 30 s
+        assert router.stats.report()["spill"]["spilled"] == 0
+    finally:
+        router.stop()
+
+
+# -- router: retry budget ----------------------------------------------------
+
+
+def test_retry_budget_exhaustion_under_fleet_wide_503(stub_pair):
+    """Satellite: under a fleet-wide 503 storm, the budget stops the
+    router from re-sending — each shed relays after ONE forward instead
+    of max_retries+1, and the denial is counted."""
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    s0.cfg["shed"] = s1.cfg["shed"] = True
+    router = FleetRouter(pool, affinity_on=False, max_retries=3,
+                         backoff_s=0.01, backoff_cap_s=0.05,
+                         retry_budget=0.01, retry_budget_min=0)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        for i in range(3):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(f"{base}/invoke", {"tokens": [i]})
+            assert e.value.code == 503  # the honest relayed shed
+        rep = router.stats.report()
+        assert rep["retry_budget_denied"] >= 3
+        # the tiny ratio admits exactly one retry in the window; every
+        # further re-send is refused — the fleet saw 4 forwards where
+        # an unbudgeted max_retries=3 loop would have sent 12
+        assert rep["retries"] == 1
+        assert len(s0.bodies) + len(s1.bodies) == 4
+        assert router.metrics()["router"]["retry_budget"]["denied"] >= 3
+    finally:
+        router.stop()
+
+
+# -- router: circuit breakers ------------------------------------------------
+
+
+def test_breaker_opens_on_dead_replica_and_half_open_readmits(stub_pair):
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    # fail_threshold high: the POOL never ejects, isolating the breaker
+    pool.fail_threshold = 100
+    router = FleetRouter(pool, affinity_on=False, max_retries=2,
+                         backoff_s=0.01, backoff_cap_s=0.05,
+                         breaker_fails=2, breaker_open_s=0.4)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        port = s0.port
+        s0.kill()
+        # every request succeeds via failover; after 2 connect failures
+        # the breaker opens and r0 stops being offered at all
+        for i in range(6):
+            assert _post(f"{base}/invoke", {"tokens": [i]})["ok"]
+        b = router.breakers["r0"]
+        assert b.state == OPEN and b.opens >= 1
+        failovers_at_open = router.stats.report()["failovers"]
+        for i in range(4):
+            assert _post(f"{base}/invoke",
+                         {"tokens": [i]})["replica"] == "r1"
+        # open breaker = no further connection attempts at the corpse
+        assert router.stats.report()["failovers"] == failovers_at_open
+
+        # revive on the same port: after open_s the next pick half-open
+        # probes it, success closes, and traffic returns
+        s0b = StubReplica("r0", port=port)
+        time.sleep(0.5)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and s0b.invokes == 0:
+            _post(f"{base}/invoke", {"tokens": [9]})
+            time.sleep(0.02)
+        assert s0b.invokes >= 1, "traffic never returned to the revived " \
+                                 "replica"
+        assert b.state == CLOSED and b.closes >= 1
+        assert b.half_open_probes >= 1
+        rep = router.metrics()["router"]["breakers"]["r0"]
+        assert rep["state"] == CLOSED
+        s0b.kill()
+    finally:
+        router.stop()
+
+
+# -- router-side network fault injection -------------------------------------
+
+
+def test_fault_grammar_accepts_router_sites():
+    plan = FaultPlan.from_spec(
+        "route_connect:exception;route_body:exception@seg=2;"
+        "route_latency:delay@ms=50;probe:exception@seg=3,n=6")
+    assert len(plan.rules) == 4
+    with pytest.raises(ValueError):
+        FaultPlan.from_spec("route_nowhere:exception")
+
+
+def test_injected_route_connect_drops_and_fails_over(stub_pair):
+    """One injected drop: the request fails over to the other replica
+    and still lands. (Two consecutive drops would exhaust a 2-replica
+    fleet within one request — that shape is the spill tests' job.)"""
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    plan = FaultPlan.from_spec("route_connect:exception@seg=1,n=1")
+    router = FleetRouter(pool, affinity_on=False, max_retries=3,
+                         backoff_s=0.01, backoff_cap_s=0.05, faults=plan)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        for i in range(4):
+            assert _post(f"{base}/invoke", {"tokens": [i]})["ok"]
+        rep = router.stats.report()
+        assert rep["failovers"] >= 1 and rep["completed"] == 4
+        assert plan.counts()["route_connect"] >= 4
+    finally:
+        router.stop()
+
+
+def test_injected_route_latency_delays_but_delivers(stub_pair):
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    plan = FaultPlan.from_spec("route_latency:delay@ms=200,n=1")
+    router = FleetRouter(pool, affinity_on=False, faults=plan)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        t0 = time.monotonic()
+        assert _post(f"{base}/invoke", {"tokens": [1]})["ok"]
+        assert time.monotonic() - t0 >= 0.2
+        assert router.stats.report()["failovers"] == 0
+    finally:
+        router.stop()
+
+
+def test_injected_probe_fault_flaps_replica_through_pool(stub_pair):
+    s0, s1, pool = stub_pair
+    pool.probe_all()  # healthy baseline (counts on the EMPTY plan)
+    # a fresh plan counts from zero: its calls 1-2 are the next sweep
+    pool.faults = FaultPlan.from_spec("probe:exception@seg=1,n=2")
+    pool.probe_all()  # plan calls 1-2: both probes fail -> both ejected
+    assert {r.state for r in pool.replicas.values()} == {EJECTED}
+    pool.probe_all()
+    pool.probe_all()  # two clean passes -> readmitted
+    assert all(r.state == READY for r in pool.replicas.values())
+    assert all(r.ejections == 1 for r in pool.replicas.values())
+
+
+# -- first-class attached replicas -------------------------------------------
+
+
+def test_begin_drain_refuses_attached_replica(stub_pair):
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    with pytest.raises(FleetError, match="attached.*probe-only"):
+        pool.begin_drain("r0")
+    assert pool.replicas["r0"].state == READY  # untouched
+
+
+def test_rolling_restart_refuses_attach_only_pool(stub_pair):
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    with pytest.raises(FleetError, match="attached"):
+        pool.rolling_restart(live_floor=1)
+    # not an AttributeError on the missing runtime, and nothing drained
+    assert all(r.state == READY for r in pool.replicas.values())
+
+
+def test_attached_replica_eject_readmit_zero_lost(stub_pair):
+    """Attached replicas are first-class for health: kill one mid-
+    traffic and every request still lands (failover), the corpse ejects
+    at traffic speed, and the revived process readmits on consecutive
+    probe passes — zero lost requests end to end."""
+    s0, s1, pool = stub_pair
+    pool.start()
+    pool.probe_all()
+    router = FleetRouter(pool, affinity_on=False, max_retries=3,
+                         backoff_s=0.01, backoff_cap_s=0.1,
+                         spill_cap=16, spill_max_wait_s=10.0)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    stop = threading.Event()
+    ok = [0]
+    failures = []
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                assert _post(f"{base}/invoke", {"tokens": [i % 7]})["ok"]
+                ok[0] += 1
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                failures.append(repr(e))
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=traffic) for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        port = s0.port
+        s0.kill()
+        victim = pool.replicas["r0"]
+        deadline = time.monotonic() + 10
+        while victim.state != EJECTED and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert victim.state == EJECTED
+        time.sleep(0.3)  # traffic rides the survivor
+        s0b = StubReplica("r0", port=port)
+        deadline = time.monotonic() + 10
+        while victim.state != READY and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert victim.state == READY and victim.ejections == 1
+        time.sleep(0.3)  # traffic over the healed fleet
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        router.stop()
+        try:
+            s0b.kill()
+        except Exception:
+            pass
+    assert not failures, f"lost requests: {failures[:3]}"
+    assert ok[0] > 10
+
+
+# -- affinity-aware cache warming --------------------------------------------
+
+
+def test_warm_prompt_extracts_whole_block_head():
+    assert affinity.warm_prompt({"tokens": list(range(70))}, block=32) \
+        == list(range(64))
+    assert affinity.warm_prompt({"tokens": [1, 2, 3]}, block=32) is None
+    assert affinity.warm_prompt({"prompt": "x" * 300}, block=32) \
+        == "x" * 256
+    # explicit prefix is part of the replayable head
+    assert affinity.warm_prompt(
+        {"prefix": list(range(32)), "tokens": [1] * 32}, block=32) \
+        == list(range(32)) + [1] * 32
+    assert affinity.warm_prompt({"n": 3}) is None
+
+
+def test_readmitted_replica_gets_warmed_with_its_hot_prefixes(stub_pair):
+    s0, s1, pool = stub_pair
+    pool.start()
+    pool.probe_all()
+    router = FleetRouter(pool, affinity_on=True, block=4, max_retries=3,
+                         backoff_s=0.01, backoff_cap_s=0.1,
+                         warm_prefixes=4)
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    stubs = {"r0": s0, "r1": s1}
+    try:
+        # one hot prefix, hammered: the router tracks it
+        head = list(range(100, 112))  # 3 whole 4-token blocks
+        for i in range(5):
+            _post(f"{base}/invoke", {"tokens": head + [i]})
+        key = affinity.prefix_key({"tokens": head + [0]}, block=4)
+        target = affinity.pick_replica(key, sorted(pool.replicas))
+        victim = pool.replicas[target]
+        port = stubs[target].port
+        stubs[target].kill()
+        deadline = time.monotonic() + 10
+        while victim.state != EJECTED and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert victim.state == EJECTED
+        revived = StubReplica(target, port=port)
+        deadline = time.monotonic() + 10
+        while victim.state != READY and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert victim.state == READY
+        # the warm request lands on the revived replica: its hot-prefix
+        # head as a background-class 1-token completion
+        deadline = time.monotonic() + 10
+        warm = None
+        while warm is None and time.monotonic() < deadline:
+            warm = next((b for p, b in revived.bodies
+                         if p == "/v1/completions"
+                         and b.get("max_tokens") == 1), None)
+            time.sleep(0.05)
+        assert warm is not None, "readmitted replica never got a warm " \
+                                 "request"
+        assert warm["prompt"] == head and warm["temperature"] == 0
+        assert router.stats.report()["warmed_prefixes"] >= 1
+        revived.kill()
+    finally:
+        router.stop()
+
+
+def test_router_healthz_reports_spill_depth(stub_pair):
+    s0, s1, pool = stub_pair
+    pool.probe_all()
+    router = FleetRouter(pool, affinity_on=False, spill_cap=4)
+    router.start_background()
+    try:
+        h = _get(f"http://127.0.0.1:{router.port}/healthz")
+        assert h["ok"] and h["spill_depth"] == 0
+    finally:
+        router.stop()
