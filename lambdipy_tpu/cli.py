@@ -472,9 +472,23 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "/healthz to wedged (continuous engine; 0 disables "
                    "— size it ABOVE the transport's worst-case compile "
                    "wall; default: bundle engine_watchdog_s, else off)")
+@click.option("--kv-paged/--no-kv-paged", default=None,
+              help="paged KV memory for the continuous engine: one "
+                   "refcounted page arena instead of a full decode "
+                   "window per slot — admission charges actual tokens "
+                   "(more concurrent rows for mixed-length traffic) and "
+                   "prefix-cache hits share pages zero-copy. Outputs "
+                   "stay bitwise the dense path's. (default: bundle "
+                   "kv_paged, else off)")
+@click.option("--kv-pages", type=int, default=None,
+              help="page count of the paged KV arena (page width = the "
+                   "prefix block); default sizes it to the same HBM the "
+                   "dense engine would allocate: batch_max x window "
+                   "pages + the reserved null page")
 def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
-              prefix_block, pipeline_depth, engine_watchdog):
+              prefix_block, pipeline_depth, engine_watchdog, kv_paged,
+              kv_pages):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
@@ -489,6 +503,10 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_PIPELINE_DEPTH"] = str(pipeline_depth)
     if engine_watchdog is not None:
         os.environ["LAMBDIPY_ENGINE_WATCHDOG_S"] = str(engine_watchdog)
+    if kv_paged is not None:
+        os.environ["LAMBDIPY_KV_PAGED"] = "1" if kv_paged else "0"
+    if kv_pages is not None:
+        os.environ["LAMBDIPY_KV_PAGES"] = str(kv_pages)
     # BundleServer resolves the effective policy (bundle extra <
     # LAMBDIPY_SCHED_POLICY env < these flags) and bridges it to the
     # handler's batch formation itself — no env plumbing needed here

@@ -520,6 +520,83 @@ def concat_cache_blocks(cfg: LlamaConfig, blocks, cache_len: int):
     return out
 
 
+def init_page_arena(cfg: LlamaConfig, n_pages: int, page: int):
+    """The paged KV arena (runtime/pagepool.py): per layer, the decode
+    cache's store-layout leaves re-shaped page-major —
+    ``[n_pages, page, kv_heads, head_dim]`` — with NO ``index`` leaf
+    (positions live in the per-row block tables, not the storage).
+    Page 0 is the reserved null page; it starts zero like everything
+    else and only ever accumulates unread garbage."""
+    shape = (n_pages, page, cfg.kv_heads, cfg.head_dim)
+    if cfg.kv_quant == "int8":
+        return [{"k_int8": jnp.zeros(shape, jnp.int8),
+                 "k_scale": jnp.full(shape[:3] + (1,), 1e-8, jnp.float32),
+                 "v_int8": jnp.zeros(shape, jnp.int8),
+                 "v_scale": jnp.full(shape[:3] + (1,), 1e-8, jnp.float32)}
+                for _ in range(cfg.layers)]
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.layers)]
+
+
+def page_kv_bytes(cfg: LlamaConfig, page: int) -> int:
+    """Exact stored bytes of ONE page across all layers and leaves — the
+    page-granular unit of the pool's byte accounting (host arithmetic,
+    no device access)."""
+    import numpy as np
+
+    per_pos = cfg.kv_heads * cfg.head_dim
+    if cfg.kv_quant == "int8":
+        # int8 k + v values, f32 per-position-per-head scales
+        per_layer = page * (2 * per_pos + 2 * cfg.kv_heads * 4)
+    else:
+        per_layer = page * 2 * per_pos * np.dtype(cfg.dtype).itemsize
+    return int(cfg.layers * per_layer)
+
+
+def _gather_page_cache(arena, tables, window: int, page: int, index):
+    """Materialize each row's first ``window`` positions from its block
+    table into a contiguous decode cache (one dict per layer, ``index``
+    attached) — the XLA twin of the paged kernel's table-lookup DMA.
+    tables: [b, >= window/page] int32 page ids; entries past a row's
+    allocation point at the null page, whose values are only ever read
+    masked. The gathered values are bitwise the pages' values, so every
+    downstream program (the shared ``_scan_decode``, the continuation)
+    sees exactly what a dense contiguous cache would hold."""
+    nb = window // page
+    b = tables.shape[0]
+    cols = tables[:, :nb].reshape(-1)
+    out = []
+    for entry in arena:
+        e = {name: jnp.take(val, cols, axis=0).reshape(
+                 b, nb * page, *val.shape[2:])
+             for name, val in entry.items()}
+        e["index"] = index
+        out.append(e)
+    return out
+
+
+def _scatter_page_cache(arena, tables, cache, page: int):
+    """Write a contiguous per-row cache back into its block-table pages
+    (the inverse of :func:`_gather_page_cache`; ``index`` dropped).
+    Pages shared between rows (frozen prefix pages) receive their own
+    values back — decode never writes inside a row's matched prefix, so
+    the round trip is bitwise a no-op there — and null-page duplicates
+    may land in any order because nothing reads the null page
+    unmasked."""
+    b = tables.shape[0]
+    new = []
+    for aentry, centry in zip(arena, cache):
+        e = {}
+        for name, val in aentry.items():
+            c = centry[name]
+            nb = c.shape[1] // page
+            pages = c.reshape(b * nb, page, *c.shape[2:]).astype(val.dtype)
+            e[name] = val.at[tables[:, :nb].reshape(-1)].set(pages)
+        new.append(e)
+    return new
+
+
 def copy_cache(cache):
     """Fresh-buffer copy of a decode cache: safe to feed a DONATING
     program (``_prefix_ext_fn``) while the original stays live in a
@@ -1723,6 +1800,141 @@ class LlamaServer:
 
         return self._fn_cached(("seg_w", b, cache_len, window, segment),
                                build)
+
+    # -- paged KV programs (runtime/pagepool.py arena) ------------------------
+    #
+    # The paged engine's device programs. Each one follows the same
+    # shape: gather the rows' pages into the contiguous cache the
+    # EXISTING decode/continuation math expects, run that math
+    # unchanged, scatter the written pages back — so paged tokens are
+    # bitwise the dense engine's by construction (the gathered values
+    # ARE the page values, and masked positions contribute exact zeros
+    # either way). Keyed in the LRU program cache; deliberately not
+    # AOT-able (like the window-bucket variants, they are load-dependent
+    # and compile in seconds at engine shapes).
+
+    def _paged_seg_fn(self, b: int, n_pages: int, page: int, window: int,
+                      segment: int):
+        """Paged segment decode: gather each row's first ``window``
+        positions from its block table, run the shared segment scan over
+        that contiguous window (the same ``_scan_decode`` every other
+        decode path uses), scatter the advanced window back into the
+        arena. Composes with window bucketing exactly like
+        :meth:`_windowed_seg_fn` — the gather width is the pow-2 window
+        of the live batch's max context."""
+        def build():
+            def seg(params, temperature, top_k, top_p, first, lp, arena,
+                    tables, pos, done, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                cache = _gather_page_cache(arena, tables, window, page, pos)
+                (toks, lps), carry = _scan_decode(
+                    self.model, params, select, first, lp, cache, pos,
+                    done, rng, eos_id, segment, return_carry=True)
+                f2, lp2, wcache, pos2, done2, rng2 = carry
+                new_arena = _scatter_page_cache(arena, tables, wcache, page)
+                return (toks, lps), (f2, lp2, new_arena, pos2, done2, rng2)
+
+            return jax.jit(seg)
+
+        return self._fn_cached(("pseg", b, n_pages, page, window, segment),
+                               build)
+
+    def _paged_pack_fn(self, gb: int, n_pages: int, page: int, width: int):
+        """Pack row ``src`` of a ``gb``-row contiguous prefill carry into
+        batch slot ``slot`` — the scalar leaves via the same
+        dynamic-update-slice the dense pack uses, the cache row
+        scattered into the slot's block-table pages. Table entries past
+        the row's allocation are the null page (the prefill cache is
+        zeros there, so the null page just absorbs zeros)."""
+        def build():
+            def pack(tok, lp, pos, done, keys, group_carry, src, slot,
+                     arena, table):
+                def upd(b_leaf, g_leaf):
+                    row = jax.lax.dynamic_slice_in_dim(g_leaf, src, 1, 0)
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        b_leaf, row.astype(b_leaf.dtype), slot, 0)
+
+                gtok, glp, gcache, gpos, gdone, gkeys = group_carry
+                new5 = (upd(tok, gtok), upd(lp, glp), upd(pos, gpos),
+                        upd(done, gdone), upd(keys, gkeys))
+                nb = width // page
+                new_arena = []
+                for aentry, centry in zip(arena, gcache):
+                    e = {}
+                    for name, val in aentry.items():
+                        row = jax.lax.dynamic_slice_in_dim(
+                            centry[name], src, 1, 0)[0]  # [width, ...]
+                        pages = row.reshape(
+                            nb, page, *row.shape[1:]).astype(val.dtype)
+                        e[name] = val.at[table].set(pages)
+                    new_arena.append(e)
+                return new5, new_arena
+
+            return jax.jit(pack)
+
+        return self._fn_cached(("ppack", gb, n_pages, page, width), build)
+
+    def _paged_continue_fn(self, sbs: int, n_pages: int, page: int,
+                           window: int):
+        """Continue-prefill from SHARED prefix pages: gather the row's
+        table (matched prefix pages + freshly allocated suffix pages)
+        into a contiguous window, run the one
+        :func:`_continue_prefill` every prefix path shares, scatter the
+        written suffix back. The prefix pages are read in place and
+        written back bitwise-unchanged — this is the zero-copy hit: no
+        ``concat_cache_blocks`` assembly, no registered full-window
+        duplicate, no peak-HBM spike; the hit's cost is a refcount
+        bump plus the suffix prefill the request owes anyway."""
+        def build():
+            def cont(params, arena, table, plen, suffix, suffix_len,
+                     temperature, top_k, top_p, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                cache = _gather_page_cache(arena, table, window, page, plen)
+                first, lp0, new_cache, start, done0, keys = \
+                    _continue_prefill(self.model, params, cache, suffix,
+                                      suffix_len, select, rng, eos_id, sbs)
+                new_arena = _scatter_page_cache(arena, table, new_cache,
+                                                page)
+                return first, lp0, new_arena, start, done0, keys
+
+            return jax.jit(cont)
+
+        return self._fn_cached(("pcont", sbs, n_pages, page, window), build)
+
+    def _paged_gather_fn(self, n_pages: int, page: int, window: int):
+        """Read-only page gather -> contiguous single-row cache (index
+        attached): the prefix store's extend path continues a cold walk
+        from cached pages without any host-visible assembly."""
+        def build():
+            def g(arena, table, index):
+                return _gather_page_cache(arena, table, window, page,
+                                          index)
+
+            return jax.jit(g)
+
+        return self._fn_cached(("pgather", n_pages, page, window), build)
+
+    def _page_write_fn(self, n_pages: int, page: int):
+        """Write one block's per-layer KV slices (as
+        :func:`slice_cache_blocks` returns) into arena page ``pid`` —
+        the prefix store's insertion primitive (one program total; the
+        page id is a traced operand)."""
+        def build():
+            def w(arena, pid, block_kv):
+                new = []
+                for aentry, bentry in zip(arena, block_kv):
+                    e = {}
+                    for name, val in aentry.items():
+                        blk = bentry[name].reshape(
+                            1, page, *val.shape[2:]).astype(val.dtype)
+                        e[name] = jax.lax.dynamic_update_slice(
+                            val, blk, (pid,) + (0,) * (val.ndim - 1))
+                    new.append(e)
+                return new
+
+            return jax.jit(w)
+
+        return self._fn_cached(("pwrite", n_pages, page), build)
 
     def _stream_prefix_fn(self, sbs: int, cache_len: int | None = None):
         """Continue-prefill program for streaming-from-a-cached-prefix:

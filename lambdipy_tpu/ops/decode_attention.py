@@ -220,6 +220,198 @@ def blocked_decode_attention(q, k, v, active_len, *, k_scale=None,
     return out.reshape(b, kvh, group, d).reshape(b, 1, h, d)
 
 
+def paged_decode_attention_reference(q, k_pages, v_pages, block_tables,
+                                     active_len, *, k_scale_pages=None,
+                                     v_scale_pages=None, scale=None):
+    """Pure-jax oracle for PAGED decode attention, mirroring
+    :func:`decode_attention_reference` operation for operation after one
+    extra step: materialize each row's KV from its block table.
+
+    q: [b, s, h, d]; k_pages/v_pages: [P, page, kvh, d] — the paged KV
+    arena (``models/llama.py init_page_arena``); block_tables: [b, nb]
+    int32 — row r's absolute positions ``[j*page, (j+1)*page)`` live in
+    arena page ``block_tables[r, j]``; active_len: [b]. Table entries at
+    or past a row's length may point anywhere (the null page): their
+    values are masked to exact zeros by the same ``active_len`` mask the
+    dense reference applies, so on tables whose gathered values equal a
+    dense cache's the output is BITWISE the dense reference's."""
+    b, nb = block_tables.shape
+    page = k_pages.shape[1]
+    tbl = jnp.asarray(block_tables, jnp.int32).reshape(-1)
+
+    def gather(pages):
+        g = jnp.take(pages, tbl, axis=0)  # [b*nb, page, kvh, w]
+        return g.reshape(b, nb * page, *pages.shape[2:])
+
+    k, v = gather(k_pages), gather(v_pages)
+    if k_scale_pages is not None:
+        k = k.astype(q.dtype) * gather(k_scale_pages).astype(q.dtype)
+        v = v.astype(q.dtype) * gather(v_scale_pages).astype(q.dtype)
+    return decode_attention_reference(q, k, v, active_len, scale=scale)
+
+
+def _paged_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                  l_ref, acc_ref, *, page: int, scale: float,
+                  quant: bool, ks_ref=None, vs_ref=None):
+    """One (row x kv-head, kv-page) grid step of the paged kernel: the
+    same online-softmax math as ``_decode_kernel``, with the K/V block
+    fetched through the row's BLOCK TABLE instead of a contiguous
+    offset. The table itself is consumed ONLY by the ``kv_index``
+    BlockSpec maps (scalar prefetch) — inside the kernel body the
+    indirection is already done, so only the shapes differ (refs carry
+    a singleton kv-head axis cut from the arena)."""
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+    alen = lens_ref[bh]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(ki * page < alen)
+    def _compute():
+        q = q_ref[0]           # [group, d]
+        k = k_ref[0, :, 0, :]  # [page, d]
+        v = v_ref[0, :, 0, :]
+        if quant:
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0, :].astype(jnp.float32)
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0, :].astype(jnp.float32)
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [group, page]
+        pos = ki * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < alen, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_blocked_decode_attention(q, k_pages, v_pages, block_tables,
+                                   active_len, *, k_scale_pages=None,
+                                   v_scale_pages=None, scale=None,
+                                   interpret: bool | None = None):
+    """The Pallas PAGED decode kernel: the length-aware blocked kernel
+    with the contiguous clamp in its K/V index maps replaced by a BLOCK
+    TABLE lookup riding scalar-prefetch — each (row x kv-head, page)
+    program DMAs exactly the arena page its table names, so a row's KV
+    never has to be contiguous (and prefix pages shared between rows
+    are fetched from one physical location). Shapes as
+    :func:`paged_decode_attention_reference`; q must be single-token
+    ([b, 1, h, d]). Past-the-length pages clamp to the row's LAST
+    active table entry — consecutive identical page ids elide the DMA,
+    the same early-exit economics as the contiguous kernel."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b, s, h, d = q.shape
+    if s != 1:
+        return paged_decode_attention_reference(
+            q, k_pages, v_pages, block_tables, active_len,
+            k_scale_pages=k_scale_pages, v_scale_pages=v_scale_pages,
+            scale=scale)
+    page = k_pages.shape[1]
+    kvh = k_pages.shape[2]
+    group = h // kvh
+    nb = block_tables.shape[1]
+    quant = k_scale_pages is not None
+    scale = float(d ** -0.5 if scale is None else scale)
+
+    qf = q.reshape(b, kvh, group, d).reshape(b * kvh, group, d)
+    lens = jnp.repeat(jnp.asarray(active_len, jnp.int32).reshape(b), kvh)
+    tables = jnp.asarray(block_tables, jnp.int32)
+
+    def kv_index(bh, ki, lens_ref, tables_ref):
+        # the paged indirection: the page COORDINATE comes from the
+        # row's table, clamped to its last active entry so inactive
+        # grid steps re-address the previous page (DMA elided) exactly
+        # like the contiguous kernel's clamp
+        last = jnp.maximum((lens_ref[bh] + page - 1) // page - 1, 0)
+        pid = tables_ref[bh // kvh, jnp.minimum(ki, last)]
+        return (pid, 0, bh % kvh, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, group, d), lambda bh, ki, lens, tabs: (bh, 0, 0)),
+        pl.BlockSpec((1, page, 1, d), kv_index),
+        pl.BlockSpec((1, page, 1, d), kv_index),
+    ]
+    operands = [qf, k_pages, v_pages]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, page, 1, 1), kv_index),
+            pl.BlockSpec((1, page, 1, 1), kv_index),
+        ]
+        operands += [k_scale_pages, v_scale_pages]
+
+    def kernel(lens_ref, tables_ref, q_ref, k_ref, v_ref, *rest):
+        # tables_ref rides scalar prefetch for the kv_index maps only
+        del tables_ref
+        if quant:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            ks_ref, vs_ref = None, None
+            o_ref, m_ref, l_ref, acc_ref = rest
+        _paged_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_ref, l_ref, acc_ref, page=page,
+                      scale=scale, quant=quant, ks_ref=ks_ref,
+                      vs_ref=vs_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * kvh, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, group, d),
+                               lambda bh, ki, lens, tabs: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * kvh, group, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(lens, tables, *operands)
+    return out.reshape(b, kvh, group, d).reshape(b, 1, h, d)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, active_len,
+                           *, k_scale_pages=None, v_scale_pages=None,
+                           scale=None, interpret: bool | None = None):
+    """Backend dispatcher for paged decode attention, mirroring
+    :func:`decode_attention`: the block-table kernel on TPU for
+    single-token steps, the gather-then-dense reference everywhere else
+    (bitwise the dense path on float KV — the runtime's paged engine
+    gathers through the same tables, so the two agree by
+    construction)."""
+    if jax.default_backend() == "tpu" and q.shape[1] == 1:
+        return paged_blocked_decode_attention(
+            q, k_pages, v_pages, block_tables, active_len,
+            k_scale_pages=k_scale_pages, v_scale_pages=v_scale_pages,
+            scale=scale, interpret=interpret)
+    return paged_decode_attention_reference(
+        q, k_pages, v_pages, block_tables, active_len,
+        k_scale_pages=k_scale_pages, v_scale_pages=v_scale_pages,
+        scale=scale)
+
+
 def decode_attention(q, k, v, active_len, *, k_scale=None, v_scale=None,
                      scale=None, block_k: int = 128,
                      interpret: bool | None = None):

@@ -108,6 +108,13 @@ class RequestCancelled(RuntimeError):
     (closed stream socket) or its deadline expired mid-decode."""
 
 
+class _StaleArena(Exception):
+    """The page arena was reset (engine failure) between a prefix
+    acquisition and its continuation: the shared pages the continuation
+    would read are zeroed now. The admission falls back to the dense
+    solo path instead of serving wrong KV."""
+
+
 class ContinuousBatcher:
     """Segment-boundary continuous batching over a LlamaServer."""
 
@@ -119,7 +126,8 @@ class ContinuousBatcher:
                  watchdog_s: float = 0.0, max_replays: int = 1,
                  faults: FaultPlan | None = None,
                  degrade_window_s: float = 60.0,
-                 degrade_clean_s: float = 30.0):
+                 degrade_clean_s: float = 30.0,
+                 page_pool: Any = None):
         import jax
 
         from lambdipy_tpu.runtime.metrics import (DecodeWindowStats,
@@ -165,6 +173,25 @@ class ContinuousBatcher:
         # interleave with engine segments on the device queue instead
         # of stalling in-flight decode behind one wide program
         self.group_prefill_max = max(0, group_prefill_max)
+        # -- paged KV (runtime/pagepool.py) ----------------------------------
+        # a PagePool turns the engine's KV residency from B full windows
+        # into refcounted pages over one arena: admission charges
+        # ceil(actual tokens / page) pages, prefix hits share pages by
+        # refcount bump, and the decode segments gather/scatter each
+        # row's pages through its block table (models/llama.py paged
+        # program family) — tokens stay bitwise the dense engine's.
+        self.pool = page_pool
+        # paged prefix hits resolve prefix tokens -> (page ids, length)
+        # through this hook (the handler wires the radix store's
+        # acquire_pages); None = prefix rows fall back solo
+        self.prefix_pages_fn = None
+        if self.pool is not None:
+            if self.cache_len % self.pool.page:
+                raise ValueError(
+                    f"page {self.pool.page} does not divide engine "
+                    f"cache_len {self.cache_len}")
+            self.pool.window_pages = self.cache_len // self.pool.page
+        self._pack5_fn = None  # scalar-leaf pack for paged prefix carries
         # -- fault isolation -------------------------------------------------
         # watchdog_s bounds every device-side wait the ENGINE thread
         # makes (dispatch, per-segment fetch, group prefill) plus the
@@ -179,6 +206,10 @@ class ContinuousBatcher:
         self.max_replays = max(0, int(max_replays))
         self.faults = faults if faults is not None else FaultPlan.empty()
         self.fault_stats = EngineFaultStats()
+        if self.pool is not None and self.pool.faults is None:
+            # the engine's armed plan drives the page_alloc site too, so
+            # one LAMBDIPY_FAULT spec covers allocator chaos
+            self.pool.faults = self.faults
         # degradation ladder: >= 2 failures inside degrade_window_s step
         # the level (1: pipeline depth -> 1, 2: + window bucketing off,
         # 3: + prefix cache bypassed); degrade_clean_s without a failure
@@ -219,22 +250,29 @@ class ContinuousBatcher:
     # -- device helpers ------------------------------------------------------
 
     def _init_carry(self):
-        """Fresh all-inactive B-slot carry (device)."""
+        """Fresh all-inactive B-slot carry (device). Paged engines carry
+        only the scalar leaves — the KV lives in the pool's arena, which
+        PERSISTS across engine restarts (replayed rows re-scatter their
+        pages; frozen prefix pages survive untouched)."""
         import jax.numpy as jnp
 
         from lambdipy_tpu.models.llama import init_decode_cache
 
         cfg = self.server.model.cfg
         b = self.slots
+        scalars = (jnp.zeros((b,), jnp.int32),      # tok
+                   jnp.zeros((b,), jnp.float32),    # lp
+                   jnp.zeros((b,), jnp.int32),      # pos
+                   jnp.zeros((b,), jnp.bool_),      # done (never latches)
+                   jnp.zeros((b, 2), jnp.uint32))   # per-row PRNG keys
+        if self.pool is not None:
+            self.pool.ensure_arena()
+            return scalars
         cache = init_decode_cache(cfg, b, self.cache_len)
         for entry in cache:
             entry["index"] = jnp.zeros((b,), jnp.int32)
-        return (jnp.zeros((b,), jnp.int32),      # tok
-                jnp.zeros((b,), jnp.float32),    # lp
-                cache,
-                jnp.zeros((b,), jnp.int32),      # pos
-                jnp.zeros((b,), jnp.bool_),      # done (never latches)
-                jnp.zeros((b, 2), jnp.uint32))   # per-row PRNG keys
+        tok, lp, pos, done, keys = scalars
+        return (tok, lp, cache, pos, done, keys)
 
     def _pack(self, carry, group_carry, src: int, slot: int):
         """Write row ``src`` of a (1..b)-row carry into batch slot
@@ -263,6 +301,147 @@ class ContinuousBatcher:
 
         return self._pack_fn(carry, group_carry, jnp.int32(src),
                              jnp.int32(slot))
+
+    # -- paged-KV helpers ----------------------------------------------------
+
+    def _table_row(self, entry: dict, nb: int):
+        """Entry's block table as ``nb`` int32 page ids, null-padded —
+        the host-truth view the paged programs index by."""
+        import numpy as np
+
+        pids = entry.get("pages") or []
+        row = np.zeros((nb,), np.int32)
+        take = min(nb, len(pids))
+        row[:take] = pids[:take]
+        return row
+
+    def _release_pages(self, entry: dict) -> None:
+        """Idempotently return an entry's pages to the pool (refcount
+        drop; shared prefix pages stay live under the store's ref)."""
+        pids = entry.pop("pages", None)
+        if pids and self.pool is not None:
+            try:
+                self.pool.release(pids)
+            except Exception as e:  # noqa: BLE001 — accounting must not
+                # take the engine down; the invariant tests catch bugs
+                log.error("page release failed: %s", e)
+
+    def _charge_pages(self, entry: dict, tokens: int,
+                      shared: list | None = None) -> None:
+        """Admission charges pages for the row's ACTUAL tokens (prompt +
+        prefix + requested decode). Shared prefix pages ride in already
+        refcount-bumped; only the remainder allocates. PagesExhausted
+        propagates priced; any other allocator failure (an armed
+        ``page_alloc`` fault, an accounting bug) sheds THIS row as
+        backpressure instead of failing the engine."""
+        from lambdipy_tpu.runtime.pagepool import PagesExhausted
+
+        shared = shared or []
+        page = self.pool.page
+        need = -(-tokens // page) - len(shared)
+        try:
+            fresh = self.pool.alloc(max(0, need),
+                                    tokens=tokens - len(shared) * page)
+        except PagesExhausted:
+            if shared:
+                self.pool.release(shared)
+            raise
+        except Exception as e:  # noqa: BLE001 — injected fault / bug
+            if shared:
+                self.pool.release(shared)
+            self.fault_stats.record_failure(
+                getattr(e, "fault_site", "page_alloc"))
+            raise PagesExhausted(
+                max(0, need), self.pool.free_count(),
+                self.pool.retry_after_s(max(1, need))) from e
+        entry["pages"] = list(shared) + fresh
+
+    def _pack5(self, carry5, row_carry5, slot: int):
+        """Pack a 1-row scalar carry (a paged prefix continuation, whose
+        KV is already in the arena) into batch slot ``slot``."""
+        import jax
+
+        if self._pack5_fn is None:
+            def pack(batch, row, slot):
+                def upd(b_leaf, g_leaf):
+                    r = jax.lax.dynamic_slice_in_dim(g_leaf, 0, 1, 0)
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        b_leaf, r.astype(b_leaf.dtype), slot, 0)
+
+                return tuple(upd(b, g) for b, g in zip(batch, row))
+
+            self._pack5_fn = jax.jit(pack)
+        import jax.numpy as jnp
+
+        return self._pack5_fn(carry5, row_carry5, jnp.int32(slot))
+
+    def _pack_paged(self, carry5, group_carry, src: int, joiner: dict):
+        """Pack row ``src`` of a contiguous prefill carry into the paged
+        batch: scalars into the 5-leaf carry, the KV row scattered into
+        the joiner's pages (under the arena chain lock)."""
+        import jax.numpy as jnp
+
+        from lambdipy_tpu.models.llama import cache_width
+
+        pool = self.pool
+        width = cache_width(group_carry[2])
+        gb = group_carry[0].shape[0]
+        fn = self.server._paged_pack_fn(gb, pool.n_pages, pool.page, width)
+        table = jnp.asarray(self._table_row(joiner, width // pool.page))
+        with pool.arena_lock:
+            new5, new_arena = fn(*carry5, group_carry, jnp.int32(src),
+                                 jnp.int32(joiner["slot"]), pool.arena,
+                                 table)
+            pool.arena = new_arena
+        return new5
+
+    def _paged_continue_row(self, entry: dict):
+        """Suffix continue-prefill for a paged prefix hit: the matched
+        pages are read IN PLACE through the block table and only the
+        suffix writes (into the entry's fresh pages) — the zero-copy
+        twin of ``_prefill_prefix_row``. Returns the 5-leaf row carry;
+        the arena chain advances under the pool lock."""
+        import jax.numpy as jnp
+
+        from lambdipy_tpu.models.llama import _next_bucket
+
+        server = self.server
+        pool = self.pool
+        plen, s = entry["plen"], entry["s"]
+        server._validate(plen + s, entry["n"])
+        # clamped to the ENGINE window (== max_len on every routed
+        # configuration, so the padded width — and with it the traced
+        # shapes — matches the dense prefix path exactly): a wider
+        # bucket would let the suffix write clamp back onto real KV
+        # inside the gathered window
+        sbs = min(_next_bucket(s, server.min_bucket),
+                  self.cache_len - plen)
+        # gather at the full engine window: the continuation then traces
+        # at exactly the shapes the dense prefix path uses, keeping the
+        # bitwise argument a shape identity rather than a reduction-
+        # order proof
+        window = self.cache_len
+        cont = server._paged_continue_fn(sbs, pool.n_pages, pool.page,
+                                         window)
+        table = jnp.asarray(
+            self._table_row(entry, window // pool.page))[None, :]
+        suffix_op, _ = server._pad_rows([entry["row"]], [s], 1, sbs)
+        knobs = server._knob_operands(
+            entry["temperature"], entry["top_k"], entry["top_p"],
+            entry["seed"], None, b=1)
+        with pool.arena_lock:
+            if entry.get("arena_gen") is not None \
+                    and entry["arena_gen"] != pool.arena_generation:
+                # the arena reset between the acquire and here: the
+                # shared prefix pages are zeroed — do NOT read them
+                raise _StaleArena()
+            pool.ensure_arena()
+            with server._mesh_ctx():
+                first, lp0, new_arena, start, done0, keys = cont(
+                    server.params, pool.arena, table, jnp.int32(plen),
+                    suffix_op, jnp.int32(s), *knobs)
+            pool.arena = new_arena
+        return (first, lp0, start, done0, keys)
 
     def _prefill_row(self, row, s: int, entry: dict):
         """Single-row bucketed prefill -> 1-row carry over the engine's
@@ -609,6 +788,7 @@ class ContinuousBatcher:
                        else "deadline expired"))
                 e["done"] = True
                 self._active[slot] = None
+                self._release_pages(e)
                 self.fault_stats.record_cancelled()
         for j in [j for j in self._joiners if self._cancel_due(j, now)]:
             j["error"] = RequestCancelled(
@@ -617,6 +797,7 @@ class ContinuousBatcher:
                    else "deadline expired"))
             j["done"] = True
             self._joiners.remove(j)
+            self._release_pages(j)
             self.fault_stats.record_cancelled()
 
     def _fail_engine(self, error: Exception, *, site: str,
@@ -652,7 +833,10 @@ class ContinuousBatcher:
                 if entry["done"]:
                     # completed mid-pipeline (slot held as garbage until
                     # the next barrier): its bitwise-valid result is
-                    # already readable — never overwrite it
+                    # already readable — never overwrite it. Its pages
+                    # release here: the barrier that would have freed
+                    # them dies with this engine.
+                    self._release_pages(entry)
                     continue
                 if (not entry["streamed"] and not entry["abandoned"]
                         and entry["replays"] < self.max_replays):
@@ -666,16 +850,39 @@ class ContinuousBatcher:
                     entry["slot"] = None
                     entry["packed"] = False
                     entry["carry"] = None  # re-prefills in the engine
+                    if self.pool is not None \
+                            and entry.get("prefix_toks"):
+                        # the arena reset below zeroes the shared pages
+                        # a zero-copy continuation would read: replay as
+                        # a FULL cold row through the row's own (kept)
+                        # pages — the prefill recomputes exactly the KV
+                        # they held, so the replay stays bitwise
+                        entry["row"] = entry["prefix_toks"] + entry["row"]
+                        entry["s"] = len(entry["row"])
+                        entry["pos0"] = entry["s"]
+                        entry["prefix_toks"] = None
+                        entry.pop("plen", None)
+                        entry.pop("arena_gen", None)
                     survivors.append(entry)
                     requeued += 1
                 else:
                     entry["error"] = error
                     entry["done"] = True
+                    self._release_pages(entry)
             if requeued:
                 self.fault_stats.record_replays(attempted=requeued)
             self._joiners = survivors
             self._active = [None] * self.slots
             self._carry = None  # rebuilt clean on restart
+            if self.pool is not None:
+                # on an async backend the published arena may be the
+                # OUTPUT of the failed computation — every program
+                # consuming it would re-raise. Discard it (the paged
+                # twin of dropping the carry): replays re-prefill and
+                # re-scatter into their kept pages, and the prefix
+                # store flushes its now-stale tree on the generation
+                # bump. Page ACCOUNTING (host truth) is unaffected.
+                self.pool.reset_arena()
             if survivors:
                 self._engine_running = True
                 threading.Thread(target=self._engine_loop,
@@ -710,7 +917,11 @@ class ContinuousBatcher:
         server = self.server
         from lambdipy_tpu.models.llama import _next_bucket
 
-        seg_full = self._segment_fn()
+        pool = self.pool
+        # paged engines never touch the dense B-slot segment program (the
+        # KV lives in the pool's arena, not a batch cache) — building it
+        # would compile a program family this engine can't dispatch
+        seg_full = self._segment_fn() if pool is None else None
         # eos stays disabled on device (host-side truncation); the
         # sampling knobs are PER-SLOT vectors rebuilt before each
         # segment from the active rows' own requests
@@ -851,7 +1062,10 @@ class ContinuousBatcher:
                         if e is not None and e["done"]:
                             # finished mid-pipeline: the slot decoded as
                             # a garbage row until this barrier; free it
+                            # (a paged row's pages go back to the pool —
+                            # shared prefix pages only drop one ref)
                             self._active[slot] = None
+                            self._release_pages(e)
                     free = [i for i, a in enumerate(self._active)
                             if a is None]
                     if self._joiners and free:
@@ -900,10 +1114,19 @@ class ContinuousBatcher:
                 for j in [a for a in packing if a.get("carry") is None
                           and a.get("prefix_toks") is not None]:
                     try:
-                        j["carry"] = self._device_wait(
-                            "prefix_assemble", gen,
-                            self._prefill_prefix_row, j["prefix_toks"],
-                            j["row"], j["s"], j)
+                        if pool is not None:
+                            # a replayed PAGED prefix row kept its pages
+                            # (shared prefix + own suffix) through the
+                            # failure: re-run the same zero-copy
+                            # continuation — bitwise the first attempt
+                            j["carry"] = self._device_wait(
+                                "prefix_assemble", gen,
+                                self._paged_continue_row, j)
+                        else:
+                            j["carry"] = self._device_wait(
+                                "prefix_assemble", gen,
+                                self._prefill_prefix_row, j["prefix_toks"],
+                                j["row"], j["s"], j)
                         carried.append(j)
                     except (_StaleEngine, EngineWatchdogTimeout):
                         raise
@@ -921,6 +1144,7 @@ class ContinuousBatcher:
                                 "prefix_assemble")
                             j["error"], j["done"] = e, True
                             self._active[j["slot"]] = None
+                            self._release_pages(j)
                             self._lock.notify_all()
                 for j in long_replay:
                     ck = self.server.prefill_chunk
@@ -946,6 +1170,7 @@ class ContinuousBatcher:
                                         "group_prefill"))
                             j["error"], j["done"] = e, True
                             self._active[j["slot"]] = None
+                            self._release_pages(j)
                             self._lock.notify_all()
                 group_carry = None
                 if raw:
@@ -990,21 +1215,50 @@ class ContinuousBatcher:
                                     retried += 1
                                 else:
                                     j["error"], j["done"] = e, True
+                                    self._release_pages(j)
                             if retried:
                                 self.fault_stats.record_replays(
                                     attempted=retried)
                             self._lock.notify_all()
                         raw = []
                 for src, joiner in enumerate(raw):
-                    self._carry = self._pack(self._carry, group_carry, src,
-                                             joiner["slot"])
+                    if pool is not None:
+                        # scalars into the 5-leaf carry, the KV row
+                        # scattered into the joiner's pages
+                        self._carry = self._pack_paged(
+                            self._carry, group_carry, src, joiner)
+                    else:
+                        self._carry = self._pack(self._carry, group_carry,
+                                                 src, joiner["slot"])
                     joiner["packed"] = True
                 group_carry = None  # free the group cache
                 for joiner in carried:
-                    self._carry = self._pack(self._carry, joiner["carry"],
-                                             0, joiner["slot"])
+                    if pool is not None and len(joiner["carry"]) == 5:
+                        # paged prefix continuation: the row's KV is
+                        # already in the arena — only scalars pack
+                        self._carry = self._pack5(
+                            self._carry, joiner["carry"], joiner["slot"])
+                    elif pool is not None:
+                        # a dense 1-row prefill carry (solo / chunked
+                        # long-prompt path): scatter its cache row into
+                        # the joiner's pages on the way in
+                        self._carry = self._pack_paged(
+                            self._carry, joiner["carry"], 0, joiner)
+                    else:
+                        self._carry = self._pack(self._carry,
+                                                 joiner["carry"], 0,
+                                                 joiner["slot"])
                     joiner["carry"] = None  # free the 1-row cache
                     joiner["packed"] = True
+                if pool is not None:
+                    # the per-slot block tables the paged segment
+                    # programs index by — host truth, rebuilt once per
+                    # barrier (slot membership only changes here)
+                    nb_full = self.cache_len // pool.page
+                    tbl_host = np.stack(
+                        [self._table_row(e, nb_full) if e is not None
+                         else np.zeros((nb_full,), np.int32)
+                         for e in self._active])
                 # ---- pipelined dispatch: keep up to pipeline_depth
                 # segments in flight; once the frontier is full, each
                 # dispatch is followed by collecting the OLDEST segment,
@@ -1042,12 +1296,21 @@ class ContinuousBatcher:
                         k_host = np.zeros((self.slots,), np.int32)
                         p_host = np.ones((self.slots,), np.float32)
                         positions = []  # live rows' dispatch positions
+                        win_pos = []    # every occupied slot's position:
+                        # a paged window must cover DONE garbage rows
+                        # too — a clamped out-of-window write would
+                        # scatter through the row's block table into a
+                        # real (possibly shared) page, where the dense
+                        # engine's private cache rows shrugged it off
                         need_lp = False
                         for slot, e in live:
                             if e["done"]:
                                 # finished mid-pipeline: still stepped
                                 # by the device (garbage) but its knobs,
                                 # window need and fetch wants are dead
+                                if pool is not None:
+                                    win_pos.append(e["pos0"] + e["disp"])
+                                    e["disp"] += self.segment
                                 continue
                             t_host[slot] = e["temperature"] or 0.0
                             k_host[slot] = e["top_k"] or 0
@@ -1056,6 +1319,7 @@ class ContinuousBatcher:
                             # the DEVICE-side position: tokens already
                             # dispatched, not yet necessarily fetched
                             positions.append(e["pos0"] + e["disp"])
+                            win_pos.append(e["pos0"] + e["disp"])
                             need_lp = need_lp or e["want_lp"]
                             e["disp"] += self.segment
                     # window bucketing: the segment's furthest write
@@ -1067,15 +1331,26 @@ class ContinuousBatcher:
                     # their out-of-window scatters drop harmlessly
                     # (nothing reads them).
                     window = self.cache_len
-                    if self.window_bucketing and positions \
+                    wpos = win_pos if pool is not None else positions
+                    if self.window_bucketing and wpos \
                             and self.fault_stats.degrade_level < 2:
                         # ladder level >= 2 pins the full-window program
                         # (no first-use window-variant compiles while
                         # the device is misbehaving)
-                        needed = max(positions) + self.segment
+                        needed = max(wpos) + self.segment
                         window = min(_next_bucket(needed, 16),
                                      self.cache_len)
-                    if window < self.cache_len:
+                    if pool is not None:
+                        # window and page are both pow-2: clamping the
+                        # window up to one page keeps the gather width a
+                        # whole number of table entries
+                        window = max(window, pool.page)
+                        seg = server._paged_seg_fn(
+                            self.slots, pool.n_pages, pool.page, window,
+                            self.segment)
+                        tbl_op = jnp.asarray(
+                            tbl_host[:, :window // pool.page])
+                    elif window < self.cache_len:
                         seg = server._windowed_seg_fn(
                             self.slots, self.cache_len, window,
                             self.segment)
@@ -1084,11 +1359,27 @@ class ContinuousBatcher:
                     t_disp = time.monotonic()
 
                     def dispatch():
-                        with server._mesh_ctx():
-                            return seg(server.params, jnp.asarray(t_host),
-                                       jnp.asarray(k_host),
-                                       jnp.asarray(p_host),
-                                       *self._carry, eos_op)
+                        knob_ops = (jnp.asarray(t_host),
+                                    jnp.asarray(k_host),
+                                    jnp.asarray(p_host))
+                        if pool is None:
+                            with server._mesh_ctx():
+                                return seg(server.params, *knob_ops,
+                                           *self._carry, eos_op)
+                        # paged dispatch advances the arena chain: the
+                        # lock holds for enqueue time only (dispatch is
+                        # async), but the next arena reader must see
+                        # this segment's scatter
+                        tok_c, lp_c, pos_c, done_c, keys_c = self._carry
+                        with pool.arena_lock:
+                            with server._mesh_ctx():
+                                out, (f2, lp2, new_arena, pos2, done2,
+                                      rng2) = seg(
+                                    server.params, *knob_ops, tok_c,
+                                    lp_c, pool.arena, tbl_op, pos_c,
+                                    done_c, keys_c, eos_op)
+                            pool.arena = new_arena
+                        return out, (f2, lp2, pos2, done2, rng2)
 
                     (toks, lps), self._carry = self._device_wait(
                         "segment_dispatch", gen, dispatch)
@@ -1191,27 +1482,72 @@ class ContinuousBatcher:
                                  if deadline_ms else None),
                  "cls": current_request_class(), "seq": next(_entry_seq)}
         if prefix is not None:
-            # a prefix carry can only pack into an engine whose slots
-            # match its cache width — gate on the ENTRY's actual shape
-            # (today always the full context window, but the stored
-            # cache is the source of truth, not the config constant).
-            # The fetched entry rides into the prefill so the gate and
-            # the continuation use the SAME cache (no second lookup,
-            # no eviction window between them).
-            from lambdipy_tpu.models.llama import cache_width
+            if self.pool is not None:
+                # paged prefix hit: resolve the prefix to SHARED arena
+                # pages (refcount bump — the zero-copy path) and charge
+                # only the suffix + decode remainder; an unknown prefix
+                # (explicit client prefix= that never routed through
+                # the radix store, or a hit evicted meanwhile) serves
+                # solo through the dense server path
+                from lambdipy_tpu.runtime.pagepool import PagesExhausted
 
-            pentry = self.server._prefix_entry(prefix)
-            if self.cache_len != cache_width(pentry[0]):
-                return None
-            entry["pos0"] = pentry[1] + s
-            entry["prefix_toks"] = \
-                np.asarray(prefix, np.int32).reshape(-1).tolist()
-            # guarded as a request-kind wait: the watchdog bounds an
-            # injected prefix-assembly hang (the abort raises here, to
-            # this caller) without wedging the shared engine
-            entry["carry"] = self._device_wait(
-                "prefix_assemble", None, self._prefill_prefix_row,
-                prefix, row, s, entry, pentry, kind="request")
+                # generation read BEFORE the acquire: a reset between
+                # them is caught by _paged_continue_row's check (the
+                # store's flush makes post-reset acquires miss anyway)
+                arena_gen = self.pool.arena_generation
+                acq = (self.prefix_pages_fn(prefix)
+                       if self.prefix_pages_fn is not None else None)
+                if acq is None:
+                    return None
+                pids, plen = acq
+                need_total = -(-(plen + s + max_new_tokens)
+                               // self.pool.page)
+                if plen + s + max_new_tokens > self.cache_len \
+                        or need_total > self.pool.capacity_pages:
+                    # a row no engine window (or arena) could EVER hold
+                    # serves solo — only a TRANSIENTLY full arena sheds
+                    self.pool.release(pids)
+                    return None
+                entry["plen"] = plen
+                entry["pos0"] = plen + s
+                entry["arena_gen"] = arena_gen
+                entry["prefix_toks"] = \
+                    np.asarray(prefix, np.int32).reshape(-1).tolist()
+                self._charge_pages(entry, plen + s + max_new_tokens,
+                                   shared=pids)
+                try:
+                    entry["carry"] = self._device_wait(
+                        "prefix_assemble", None, self._paged_continue_row,
+                        entry, kind="request")
+                except _StaleArena:
+                    self._release_pages(entry)
+                    return None
+                except BaseException:
+                    self._release_pages(entry)
+                    raise
+            else:
+                # a prefix carry can only pack into an engine whose
+                # slots match its cache width — gate on the ENTRY's
+                # actual shape (today always the full context window,
+                # but the stored cache is the source of truth, not the
+                # config constant). The fetched entry rides into the
+                # prefill so the gate and the continuation use the SAME
+                # cache (no second lookup, no eviction window between
+                # them).
+                from lambdipy_tpu.models.llama import cache_width
+
+                pentry = self.server._prefix_entry(prefix)
+                if self.cache_len != cache_width(pentry[0]):
+                    return None
+                entry["pos0"] = pentry[1] + s
+                entry["prefix_toks"] = \
+                    np.asarray(prefix, np.int32).reshape(-1).tolist()
+                # guarded as a request-kind wait: the watchdog bounds an
+                # injected prefix-assembly hang (the abort raises here,
+                # to this caller) without wedging the shared engine
+                entry["carry"] = self._device_wait(
+                    "prefix_assemble", None, self._prefill_prefix_row,
+                    prefix, row, s, entry, pentry, kind="request")
             with self._lock:
                 self.prefix_joins += 1
         else:
@@ -1224,6 +1560,16 @@ class ContinuousBatcher:
                 # can't hold
                 return None
             self.server._validate(s, max_new_tokens)
+            if self.pool is not None:
+                # token-bounded admission: the row charges pages for
+                # what it will actually hold, not a window. A row no
+                # arena could EVER hold serves solo; a transiently full
+                # arena sheds priced (PagesExhausted -> 503 +
+                # Retry-After at the HTTP layer).
+                need = -(-(s + max_new_tokens) // self.pool.page)
+                if need > self.pool.capacity_pages:
+                    return None
+                self._charge_pages(entry, s + max_new_tokens)
             # The engine's segments emit the tokens either way (the
             # scan re-emits the carry's first token, so everything
             # flows from the segment outputs — nothing is delivered
@@ -1232,15 +1578,19 @@ class ContinuousBatcher:
             # long prompts prefill here on the request thread — in
             # chunks when the server has prefill_chunk, so engine
             # segments interleave instead of stalling.
-            if s <= self.group_prefill_max:
-                entry["carry"] = None
-            else:
-                ck = self.server.prefill_chunk
-                if ck and s > ck and self.cache_len % ck == 0:
-                    entry["carry"] = self._prefill_row_chunked(row, s,
-                                                               entry)
+            try:
+                if s <= self.group_prefill_max:
+                    entry["carry"] = None
                 else:
-                    entry["carry"] = self._prefill_row(row, s, entry)
+                    ck = self.server.prefill_chunk
+                    if ck and s > ck and self.cache_len % ck == 0:
+                        entry["carry"] = self._prefill_row_chunked(row, s,
+                                                                   entry)
+                    else:
+                        entry["carry"] = self._prefill_row(row, s, entry)
+            except BaseException:
+                self._release_pages(entry)
+                raise
         with self._lock:
             self._joiners.append(entry)
             if not self._engine_running:
@@ -1393,4 +1743,6 @@ class ContinuousBatcher:
                     "rows_group_prefilled": self.rows_group_prefilled,
                     "prefix_joins": self.prefix_joins,
                     "active_rows": active,
-                    "waiting_joiners": len(self._joiners)}
+                    "waiting_joiners": len(self._joiners),
+                    **({"page_pool": self.pool.stats()}
+                       if self.pool is not None else {})}

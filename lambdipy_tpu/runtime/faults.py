@@ -14,6 +14,7 @@ site                      where it fires
 ``group_prefill``         the engine's ragged b-row joiner prefill
 ``prefix_assemble``       continue-prefill from a cached prefix KV
 ``transport``             the ``block_until_ready`` device wait before fetch
+``page_alloc``            the paged-KV pool taking pages for an admission
 ``route_connect``         the fleet router opening a replica connection
 ``route_body``            the router reading a replica response body
 ``route_latency``         the router's forward path (network latency site)
@@ -57,7 +58,7 @@ import time
 from dataclasses import dataclass, field
 
 SITES = ("segment_dispatch", "segment_fetch", "group_prefill",
-         "prefix_assemble", "transport",
+         "prefix_assemble", "transport", "page_alloc",
          # fleet-layer (router/pool) network sites
          "route_connect", "route_body", "route_latency", "probe")
 KINDS = ("exception", "delay", "hang")

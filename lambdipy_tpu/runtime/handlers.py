@@ -401,6 +401,14 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         from lambdipy_tpu.sched.policy import make_policy
 
         sched_policy = make_policy(str(pol_name))
+        # ONE resolution of the prefix block width, shared by the page
+        # pool (page width) and the prefix store (radix block) below —
+        # they must agree by construction, not by parallel parsing
+        raw_block = _os.environ.get("LAMBDIPY_PREFIX_BLOCK")
+        if raw_block in (None, ""):
+            raw_block = extra.get("prefix_block")
+        prefix_block = (int(raw_block) if raw_block not in (None, "")
+                        else 32)
         if batch_mode == "continuous":
             from lambdipy_tpu.runtime.continuous import ContinuousBatcher
 
@@ -449,6 +457,40 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                               _os.environ.get("LAMBDIPY_FAULT", ""))
             from lambdipy_tpu.runtime.faults import FaultPlan
 
+            # paged KV memory (runtime/pagepool.py, DEFAULT OFF): one
+            # refcounted page arena replaces the engine's B full-window
+            # caches — admission charges actual tokens, prefix hits
+            # share pages zero-copy, capacity rows scale with the
+            # workload's real lengths. `kv_paged` extra wins over the
+            # LAMBDIPY_KV_PAGED env (the `lambdipy serve --kv-paged`
+            # bridge); `kv_pages` sizes the arena (default: the same
+            # HBM the dense engine would allocate, slots x window).
+            page_pool = None
+            kvp = extra.get("kv_paged",
+                            _os.environ.get("LAMBDIPY_KV_PAGED", "0"))
+            if str(kvp).lower() not in ("", "0", "false", "off"):
+                from lambdipy_tpu.models.llama import (init_page_arena,
+                                                       page_kv_bytes)
+                from lambdipy_tpu.runtime.pagepool import (PagePool,
+                                                           page_width)
+
+                cfg_m = server.model.cfg
+                eng_len = min(int(bcl) if bcl else cfg_m.max_len,
+                              cfg_m.max_len)
+                page = page_width(eng_len, prefix_block)
+                window_pages = eng_len // page
+                raw_np = extra.get(
+                    "kv_pages", _os.environ.get("LAMBDIPY_KV_PAGES"))
+                n_pages = max(2, (int(raw_np)
+                                  if raw_np not in (None, "") else
+                                  int(extra.get("batch_max", 8))
+                                  * window_pages + 1))
+                page_pool = PagePool(
+                    n_pages=n_pages, page=page,
+                    page_bytes=page_kv_bytes(cfg_m, page),
+                    make_arena=(lambda n=n_pages, p=page:
+                                init_page_arena(cfg_m, n, p)),
+                    window_pages=window_pages)
             batcher = continuous = ContinuousBatcher(
                 server, slots=int(extra.get("batch_max", 8)),
                 segment=int(extra.get("batch_segment", 16)),
@@ -460,7 +502,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 watchdog_s=float(wd or 0),
                 max_replays=int(mr),
                 faults=(FaultPlan.from_spec(str(fspec))
-                        if str(fspec).strip() else None))
+                        if str(fspec).strip() else None),
+                page_pool=page_pool)
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 
@@ -492,18 +535,22 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         raw_mb = _os_px.environ.get("LAMBDIPY_PREFIX_CACHE_MB")
         if raw_mb in (None, ""):
             raw_mb = extra.get("prefix_cache_mb")
-        raw_block = _os_px.environ.get("LAMBDIPY_PREFIX_BLOCK")
-        if raw_block in (None, ""):
-            raw_block = extra.get("prefix_block")
         explicit_mb = raw_mb not in (None, "")
         mb = float(raw_mb) if explicit_mb else 512.0
         if mb > 0 and (server.model.cfg.kv_quant is None or explicit_mb):
             from lambdipy_tpu.runtime.prefixstore import PrefixStore
 
+            # a paged engine's store shares the engine's page arena:
+            # blocks live as refcounted pages and a hit is a refcount
+            # bump through acquire_pages (zero-copy). `prefix_block`
+            # is the ONE resolved block width the page pool sized by.
+            paged_pool = (continuous.pool if continuous is not None
+                          else None)
             prefix_store = PrefixStore(
-                server,
-                block=int(raw_block) if raw_block not in (None, "") else 32,
-                budget_mb=mb)
+                server, block=prefix_block, budget_mb=mb,
+                pool=paged_pool)
+            if paged_pool is not None:
+                continuous.prefix_pages_fn = prefix_store.acquire_pages
 
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a
@@ -1008,6 +1055,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             "compile_once": server is not None,
             "streaming": server is not None,
             "prefix_cache": prefix_store is not None,
+            "kv_paged": (continuous is not None
+                         and continuous.pool is not None),
             **({"tokenizer_error": tok_err} if tok_err else {}),
         })
 

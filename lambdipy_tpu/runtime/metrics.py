@@ -288,6 +288,58 @@ class EngineFaultStats:
 
 
 @dataclass
+class PagePoolStats:
+    """Counters for the paged KV memory manager (the
+    ``batching.page_pool`` block on ``/metrics``; gauges — pages
+    free/live/shared, fragmentation, refcount histogram, capacity rows —
+    ride on :meth:`lambdipy_tpu.runtime.pagepool.PagePool.stats`, which
+    merges this report in). ``allocs``/``alloc_pages`` count allocation
+    calls and pages taken, ``releases``/``release_pages`` pages actually
+    returned to the free list (a release of a still-shared page is a
+    refcount drop, not a free), ``shares`` refcount bumps (each one is a
+    prefix-cache hit's zero-copy page reuse), and ``sheds`` admissions
+    refused with :class:`~lambdipy_tpu.runtime.pagepool.PagesExhausted`
+    (priced 503s, not errors)."""
+
+    allocs: int = 0
+    alloc_pages: int = 0
+    releases: int = 0
+    release_pages: int = 0
+    shares: int = 0
+    sheds: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_alloc(self, pages: int) -> None:
+        with self._lock:
+            self.allocs += 1
+            self.alloc_pages += int(pages)
+
+    def record_release(self, pages: int) -> None:
+        with self._lock:
+            self.releases += 1
+            self.release_pages += int(pages)
+
+    def record_share(self, pages: int = 1) -> None:
+        with self._lock:
+            self.shares += int(pages)
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.sheds += 1
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "allocs": self.allocs,
+                "alloc_pages": self.alloc_pages,
+                "releases": self.releases,
+                "release_pages": self.release_pages,
+                "shares": self.shares,
+                "sheds": self.sheds,
+            }
+
+
+@dataclass
 class RouterStats:
     """Counters for the fleet front-door (fleet/router.py), exported on
     the router's ``/metrics`` under ``router``. ``retries`` counts
@@ -385,7 +437,12 @@ class PrefixCacheStats:
     (``hit_tokens`` = prompt tokens whose prefill was skipped), one with
     cacheable length but no match is a miss. ``bytes``/``blocks`` track
     what the store currently holds against its HBM budget; ``evictions``
-    counts blocks dropped by the budget's LRU sweep."""
+    counts blocks dropped by the budget's LRU sweep.
+    ``assembly_bytes_peak`` is the largest single full-window cache the
+    store has ASSEMBLED (``concat_cache_blocks``) for a hit — the copy +
+    peak-HBM spike the paged path eliminates, reported explicitly (always
+    present, 0 on the paged path) so "no assembly happened" is an
+    observable fact rather than a missing key."""
 
     hits: int = 0
     misses: int = 0
@@ -393,6 +450,8 @@ class PrefixCacheStats:
     evictions: int = 0
     bytes: int = 0
     blocks: int = 0
+    assembly_bytes_peak: int = 0
+    assemblies: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_request(self, matched_tokens: int) -> None:
@@ -414,6 +473,12 @@ class PrefixCacheStats:
             self.bytes -= nbytes
             self.evictions += n_blocks
 
+    def record_assembly(self, nbytes: int) -> None:
+        with self._lock:
+            self.assemblies += 1
+            self.assembly_bytes_peak = max(self.assembly_bytes_peak,
+                                           int(nbytes))
+
     def report(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
@@ -425,4 +490,6 @@ class PrefixCacheStats:
                 "evictions": self.evictions,
                 "bytes": self.bytes,
                 "blocks": self.blocks,
+                "assemblies": self.assemblies,
+                "assembly_bytes_peak": self.assembly_bytes_peak,
             }

@@ -1,0 +1,339 @@
+"""Paged KV memory manager: a page allocator over one preallocated arena.
+
+The serve path's HBM story before this module: every engine slot owns a
+full ``cache_len`` KV window whether the row holds 40 tokens or 4000,
+and a prefix-cache hit pays a ``concat_cache_blocks`` assembly copy (plus
+the peak-HBM spike of holding source blocks and the assembled window at
+once) before it can dispatch. This module is the vLLM-style
+PagedAttention step (Kwon et al., SOSP 2023), specialized to this repo's
+functional-cache serving stack:
+
+- ONE preallocated arena per layer, shaped ``[n_pages, page, kv_heads,
+  head_dim]`` (``models/llama.py init_page_arena`` builds it in the KV
+  store layout, int8 + scales included). ``page`` equals the prefix
+  store's block width, so a radix block IS a page and a block-aligned
+  prefix hit needs no re-slicing.
+- :class:`PagePool` is the HOST-side allocator: free-list reuse,
+  per-page REFCOUNTS (a prefix page shared by the radix store and N live
+  rows has refcount N+1), and exact-bytes accounting. Batch admission
+  charges ``ceil(tokens / page)`` pages — capacity is bounded by *actual*
+  tokens, not windows, which is directly more concurrent rows per chip
+  for mixed-length traffic.
+- Page 0 is the reserved NULL page: block tables pad with it, retired
+  slots point every entry at it, and over-decode writes land in it.
+  Nothing ever reads the null page unmasked (attention masks positions
+  past a row's length to exact zeros), so its garbage is harmless by the
+  same argument the dense engine uses for stale slot rows.
+- Running out of pages is BACKPRESSURE, not a bug: :class:`PagesExhausted`
+  carries a ``retry_after_s`` estimate and ``runtime/server.py`` maps it
+  to a priced 503 + Retry-After shed (reason ``kv_pages``), exactly like
+  the scheduler's queue-depth sheds.
+- The arena itself is a FUNCTIONAL jax value that every mutating program
+  (decode segment, pack, prefix continuation, block insert) consumes and
+  replaces. ``arena_lock`` serializes that chain: a mutation dispatched
+  against arena vN must publish vN+1 before the next mutation reads it,
+  or one side's writes would silently vanish from the other's copy.
+  Dispatches are async (the lock holds for enqueue time, not compute
+  time), and readers of frozen prefix pages may snapshot the reference
+  without the lock — those pages never change value.
+
+Fault injection: ``page_alloc`` is a first-class ``runtime/faults.py``
+site — an injected allocation failure surfaces as a priced shed for that
+row only, never an engine failure.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from lambdipy_tpu.runtime.metrics import PagePoolStats
+from lambdipy_tpu.utils.logs import get_logger
+
+log = get_logger("lambdipy.pagepool")
+
+NULL_PAGE = 0
+
+
+class PagesExhausted(RuntimeError):
+    """The arena has fewer free pages than an admission needs. Mapped by
+    the HTTP layer to a 503 + Retry-After shed (reason ``kv_pages``) —
+    explicit backpressure, not an internal error."""
+
+    def __init__(self, needed: int, free: int, retry_after_s: float = 1.0):
+        self.needed = int(needed)
+        self.free = int(free)
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            f"KV page pool exhausted: need {needed} pages, {free} free "
+            f"(retry in ~{self.retry_after_s:.1f}s)")
+
+
+def page_width(max_len: int, block: int) -> int:
+    """Normalize a requested page/block width exactly like the prefix
+    store does: the largest power of two <= the pow-2 bucket of
+    ``block`` that divides ``max_len`` — every page write then lands at
+    a page-aligned offset inside the context window."""
+    b = 1
+    while b < max(1, int(block)):
+        b *= 2
+    while b > 1 and max_len % b:
+        b //= 2
+    return min(b, max_len)
+
+
+class PagePool:
+    """Host-side page allocator + the owner of the device KV arena.
+
+    ``make_arena`` builds the device arena lazily on first use (boot
+    order: the pool is constructed while the bundle loads, the arena
+    allocates when the first paged program needs it). ``page_bytes`` is
+    the exact stored bytes of ONE page across all layers/leaves — the
+    unit of every byte gauge this pool reports.
+    """
+
+    def __init__(self, *, n_pages: int, page: int, page_bytes: int,
+                 make_arena: Callable[[], Any] | None = None,
+                 window_pages: int | None = None, faults: Any = None):
+        if n_pages < 2:
+            raise ValueError("n_pages must be >= 2 (page 0 is reserved)")
+        self.n_pages = int(n_pages)
+        self.page = int(page)
+        self.page_bytes = int(page_bytes)
+        # pages one full decode window costs — the denominator of the
+        # capacity_rows comparison (set by the engine from its cache_len)
+        self.window_pages = max(1, int(window_pages or 1))
+        self._make_arena = make_arena
+        self.faults = faults  # FaultPlan | None; site "page_alloc"
+        # optional last-resort reclaimer (the prefix store's
+        # reclaim_pages): called OUTSIDE the pool lock when an alloc
+        # comes up short, so store-owned cold pages yield to admission
+        # instead of starving it (lock order stays store -> pool)
+        self.reclaim_fn: Callable[[int], int] | None = None
+        self.stats_counters = PagePoolStats()
+        self._lock = threading.RLock()
+        # serializes the functional-arena chain (see module docstring);
+        # RLock so a holder may call helpers that re-enter
+        self.arena_lock = threading.RLock()
+        self._arena = None
+        # bumped by reset_arena (engine failure): stale-content guard
+        # for consumers caching page ids against arena values
+        self.arena_generation = 0
+        # LIFO free list: hot pages reuse warm HBM lines
+        self._free: list[int] = list(range(self.n_pages - 1, 0, -1))
+        # page id -> refcount; the null page is permanently pinned
+        self._refs: dict[int, int] = {NULL_PAGE: 1}
+        # page id -> tokens actually stored in it (internal-fragmentation
+        # gauge: a row's last page is usually part-full)
+        self._tokens: dict[int, int] = {}
+        # EWMA of seconds between page releases — the Retry-After price
+        self._last_release_t: float | None = None
+        self._release_gap_s = 0.25
+
+    # -- arena ---------------------------------------------------------------
+
+    @property
+    def arena(self):
+        return self._arena
+
+    @arena.setter
+    def arena(self, new) -> None:
+        self._arena = new
+
+    def ensure_arena(self):
+        """Build the device arena on first use (idempotent)."""
+        with self.arena_lock:
+            if self._arena is None:
+                if self._make_arena is None:
+                    raise RuntimeError("pool has no arena factory")
+                self._arena = self._make_arena()
+            return self._arena
+
+    def reset_arena(self) -> None:
+        """Discard the device arena (rebuilt zeroed on next use) and
+        bump the GENERATION. The engine calls this on failure: on an
+        async backend the published arena may be the output of the very
+        computation that failed, and every program consuming it would
+        re-raise — the paged twin of the dense engine discarding its
+        whole carry. Consumers holding page CONTENT expectations (the
+        prefix store's radix tree) watch ``arena_generation`` and drop
+        their state when it moves; page *accounting* (refcounts, free
+        list) is host-side truth and survives untouched."""
+        with self.arena_lock:
+            self._arena = None
+            self.arena_generation += 1
+
+    # -- allocation ----------------------------------------------------------
+
+    @property
+    def capacity_pages(self) -> int:
+        """Allocatable pages (the null page excluded)."""
+        return self.n_pages - 1
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n: int, *, tokens: int = 0,
+              record_shed: bool = True) -> list[int]:
+        """Take ``n`` pages (refcount 1 each). ``tokens`` is how many KV
+        positions the caller will actually store across them (the
+        internal-fragmentation gauge). A short free list first asks
+        ``reclaim_fn`` (the prefix store's cold-unshared-leaf release)
+        to make room — a cache must never starve admission — then
+        raises :class:`PagesExhausted`; an armed ``page_alloc`` fault
+        fires here, BEFORE any page leaves the free list, so an
+        injected failure never leaks a partial allocation.
+        ``record_shed=False`` keeps a CACHE-fill shortfall (the store
+        caching less, the request unaffected) out of the ``sheds``
+        counter, which meters refused ADMISSIONS only."""
+        n = int(n)
+        if n <= 0:
+            return []
+        if self.faults is not None:
+            self.faults.check("page_alloc")
+        if n > self.free_count() and self.reclaim_fn is not None:
+            # outside the pool lock: the reclaimer takes the store lock
+            # and re-enters release() (store -> pool order, never the
+            # reverse)
+            try:
+                self.reclaim_fn(n - self.free_count())
+            except Exception as e:  # noqa: BLE001 — reclaim is
+                # best-effort; a broken reclaimer must not turn an
+                # honest shed into an error
+                log.error("page reclaim failed: %s", e)
+        with self._lock:
+            if n > len(self._free):
+                if record_shed:
+                    self.stats_counters.record_shed()
+                raise PagesExhausted(n, len(self._free),
+                                     self.retry_after_s(n))
+            pids = [self._free.pop() for _ in range(n)]
+            left = int(tokens)
+            for pid in pids:
+                self._refs[pid] = 1
+                self._tokens[pid] = max(0, min(self.page, left))
+                left -= self.page
+            self.stats_counters.record_alloc(n)
+            return pids
+
+    def retain(self, pids) -> None:
+        """Refcount bump — how a prefix-cache hit shares pages with zero
+        copies (the radix store holds one ref, every live row another)."""
+        with self._lock:
+            for pid in pids:
+                if pid == NULL_PAGE:
+                    continue
+                if self._refs.get(pid, 0) <= 0:
+                    raise ValueError(f"retain of unallocated page {pid}")
+                self._refs[pid] += 1
+            self.stats_counters.record_share(
+                sum(1 for p in pids if p != NULL_PAGE))
+
+    def release(self, pids) -> None:
+        """Drop one ref per page; pages reaching zero return to the free
+        list. Double-free is a hard error — silent refcount corruption
+        under a shared arena is the one bug class this allocator must
+        never paper over."""
+        import time as _time
+
+        freed = 0
+        with self._lock:
+            for pid in pids:
+                if pid == NULL_PAGE:
+                    continue
+                refs = self._refs.get(pid, 0)
+                if refs <= 0:
+                    raise ValueError(f"double free of page {pid}")
+                if refs == 1:
+                    del self._refs[pid]
+                    self._tokens.pop(pid, None)
+                    self._free.append(pid)
+                    freed += 1
+                else:
+                    self._refs[pid] = refs - 1
+            self.stats_counters.record_release(freed)
+            if freed:
+                now = _time.monotonic()
+                if self._last_release_t is not None:
+                    gap = (now - self._last_release_t) / freed
+                    self._release_gap_s = (0.8 * self._release_gap_s
+                                           + 0.2 * min(gap, 30.0))
+                self._last_release_t = now
+
+    def refcount(self, pid: int) -> int:
+        """Current refcount of one page (0 = free/unallocated) — the
+        prefix store's eviction guard: a page still shared with live
+        rows must not be released by an LRU sweep."""
+        with self._lock:
+            return self._refs.get(pid, 0)
+
+    def snapshot_refs(self) -> dict:
+        """One-lock copy of every live refcount — the store's eviction
+        sweep reads it once per pass instead of paying a pool-lock
+        round-trip per candidate leaf."""
+        with self._lock:
+            return dict(self._refs)
+
+    def retry_after_s(self, needed: int = 1) -> float:
+        """Priced backpressure hint: pages free at roughly the recent
+        release cadence, so ``needed`` pages should exist in about
+        ``needed * gap`` seconds (clamped to a sane client-facing
+        range)."""
+        return max(0.5, min(30.0, float(needed) * self._release_gap_s))
+
+    # -- observability / invariants ------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = [p for p in self._refs if p != NULL_PAGE]
+            shared = [p for p in live if self._refs[p] > 1]
+            hist: dict[str, int] = {}
+            for p in live:
+                key = str(self._refs[p])
+                hist[key] = hist.get(key, 0) + 1
+            used_tokens = sum(self._tokens.get(p, 0) for p in live)
+            free = len(self._free)
+            out = {
+                "page_tokens": self.page,
+                "page_bytes": self.page_bytes,
+                "pages_total": self.capacity_pages,
+                "pages_free": free,
+                "pages_live": len(live),
+                "pages_shared": len(shared),
+                "bytes_total": self.capacity_pages * self.page_bytes,
+                "bytes_free": free * self.page_bytes,
+                "bytes_live": len(live) * self.page_bytes,
+                # allocated-but-empty token slots / allocated slots: the
+                # waste paging cannot remove (part-full tail pages)
+                "internal_fragmentation": (
+                    round(1.0 - used_tokens / (len(live) * self.page), 4)
+                    if live else 0.0),
+                "refcount_histogram": hist,
+                "max_refcount": max((self._refs[p] for p in live),
+                                    default=0),
+                # full-window rows that could still be admitted RIGHT NOW
+                # vs what a window-per-slot allocator could EVER hold in
+                # the same bytes — the capacity margin paging buys
+                "capacity_rows_now": free // self.window_pages,
+                "window_bound_rows": (self.capacity_pages
+                                      // self.window_pages),
+                "retry_after_s": round(self.retry_after_s(), 3),
+            }
+        out.update(self.stats_counters.report())
+        return out
+
+    def check_invariants(self) -> None:
+        """Test hook: every page is free XOR live exactly once, refcounts
+        are positive, and free + live bytes cover the arena exactly."""
+        with self._lock:
+            free = set(self._free)
+            live = {p for p in self._refs if p != NULL_PAGE}
+            assert len(free) == len(self._free), "free list has duplicates"
+            assert not (free & live), f"pages both free and live: {free & live}"
+            assert free | live | {NULL_PAGE} == set(range(self.n_pages)), \
+                "pages leaked out of the arena"
+            assert all(r > 0 for r in self._refs.values()), \
+                "non-positive refcount"
+            assert (len(free) + len(live)) * self.page_bytes == \
+                self.capacity_pages * self.page_bytes

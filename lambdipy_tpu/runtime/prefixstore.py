@@ -43,6 +43,16 @@ tests/test_prefixstore.py. Under ``kv_quant`` the cached prefix reads
 back quantized (tolerance-level parity), so the handler keeps automatic
 reuse opt-in there.
 
+PAGED mode (``pool=`` a :class:`lambdipy_tpu.runtime.pagepool.PagePool`):
+the tree's nodes hold arena PAGE IDS instead of host-side KV slices — a
+radix block IS a page. A full hit costs a refcount bump per page
+(:meth:`PrefixStore.acquire_pages`): no ``concat_cache_blocks``
+assembly, no registered full-window duplicate, no peak-HBM spike — the
+``assembly_bytes_peak`` gauge stays 0 by construction. Cold walks run
+the same chunk programs into a transient contiguous cache and write each
+new block into its own page; eviction is refcount-aware (only leaves no
+live row shares may release their page).
+
 Every failure path FAILS OPEN: a store error logs and the request serves
 unrouted — the cache is an optimization, never an availability risk.
 """
@@ -61,18 +71,22 @@ log = get_logger("lambdipy.prefixstore")
 
 class _Node:
     """One block of a cached prefix: ``kv`` is the per-layer store-layout
-    slice list for this block's absolute positions."""
+    slice list for this block's absolute positions (dense mode), or
+    ``page_id`` names the arena page holding them (paged mode — the
+    store owns one pool ref per node)."""
 
     __slots__ = ("parent", "token_key", "children", "kv", "nbytes",
-                 "last_used")
+                 "last_used", "page_id")
 
-    def __init__(self, parent, token_key, kv=None, nbytes=0):
+    def __init__(self, parent, token_key, kv=None, nbytes=0,
+                 page_id=None):
         self.parent = parent
         self.token_key = token_key  # tuple of this block's tokens
         self.children: dict[tuple, "_Node"] = {}
         self.kv = kv
         self.nbytes = nbytes
         self.last_used = 0
+        self.page_id = page_id
 
 
 def _slices_bytes(slices) -> int:
@@ -81,23 +95,43 @@ def _slices_bytes(slices) -> int:
                for entry in slices for v in entry.values())
 
 
+def _cache_bytes(cache) -> int:
+    """Exact bytes of one assembled full-window cache (array leaves
+    only — the scalar ``index`` is noise)."""
+    return sum(int(v.size) * v.dtype.itemsize
+               for entry in cache for v in entry.values()
+               if hasattr(v, "dtype"))
+
+
 class PrefixStore:
     """Radix-tree prefix KV store over a ``LlamaServer``."""
 
     def __init__(self, server: Any, *, block: int = 32,
-                 budget_mb: float = 512.0):
-        from lambdipy_tpu.models.llama import _next_bucket
+                 budget_mb: float = 512.0, pool: Any = None):
+        from lambdipy_tpu.runtime.pagepool import page_width
 
         self.server = server
         cfg = server.model.cfg
-        # pow-2 block that divides the context window: every block write
-        # lands at a multiple-of-block offset and must never cross
-        # max_len (dynamic_update_slice would clamp it onto real KV) —
-        # the same constraint chunked prefill enforces for prefill_chunk
-        b = _next_bucket(max(1, int(block)), 1)
-        while b > 1 and cfg.max_len % b:
-            b //= 2
-        self.block = min(b, cfg.max_len)
+        # PAGED mode (runtime/pagepool.py): a radix block IS an arena
+        # page. Nodes hold page ids instead of host-side KV slices, a
+        # hit hands its pages out by refcount bump (acquire_pages — zero
+        # copies, no assembled full-window duplicate), and eviction is a
+        # refcount-aware page release: only leaves no live row still
+        # shares may return to the pool.
+        self.pool = pool
+        if pool is not None:
+            # the pool's page width was normalized against the engine
+            # window at construction; the tree must key by the same
+            # width or block boundaries and page boundaries would drift
+            self.block = int(pool.page)
+        else:
+            # pow-2 block that divides the context window: every block
+            # write lands at a multiple-of-block offset and must never
+            # cross max_len (dynamic_update_slice would clamp it onto
+            # real KV) — the same constraint chunked prefill enforces
+            # for prefill_chunk. page_width is this exact normalization
+            # (one implementation, shared with the pool's page sizing).
+            self.block = page_width(cfg.max_len, block)
         # cold-miss walks dispatch in WIDER chunks than the tree's block
         # (block slices are cut from the final cache either way): a
         # unique long prompt should not pay one device dispatch per 32
@@ -115,7 +149,18 @@ class PrefixStore:
         self.budget_bytes = max(0, int(float(budget_mb) * 2**20))
         self.stats_counters = PrefixCacheStats()
         self._root = _Node(None, None)
-        self._lock = threading.Lock()
+        # RLock: in paged mode the pool's out-of-pages reclaim hook
+        # (reclaim_pages) re-enters through the store's own page alloc
+        self._lock = threading.RLock()
+        # arena CONTENT generation this tree's pages were written
+        # against: an engine failure resets the arena (zeroed, bumped),
+        # making every cached page stale — the tree flushes lazily on
+        # its next locked operation (_maybe_flush_stale_locked)
+        self._arena_gen = pool.arena_generation if pool is not None else 0
+        if pool is not None:
+            # admission must never starve behind a cold cache: a short
+            # pool alloc evicts this store's unshared LRU pages first
+            pool.reclaim_fn = self.reclaim_pages
         self._clock = itertools.count(1)
         # target-path key -> Event: concurrent cold requests for the same
         # prefix wait for one device walk instead of duplicating it
@@ -141,8 +186,29 @@ class PrefixStore:
         with self._lock:
             return self._match_locked(row)[0]
 
+    def _maybe_flush_stale_locked(self) -> None:
+        """Paged mode, under the store lock: if the pool's arena was
+        RESET since this tree's pages were written (engine failure —
+        their content is zeroed), drop the whole tree. Refs release
+        now; pages shared with live rows return to the free list when
+        those rows retire. Walks then re-prefill against the fresh
+        arena — correctness over cache warmth."""
+        if self.pool is None \
+                or self._arena_gen == self.pool.arena_generation:
+            return
+        self._arena_gen = self.pool.arena_generation
+        for node in list(self._iter_nodes()):
+            if node.page_id is not None:
+                self.pool.release([node.page_id])
+                self.stats_counters.record_evict(1, node.nbytes)
+                node.page_id = None
+        self._root.children = {}
+        log.info("prefix store flushed: arena generation moved "
+                 "(engine failure reset the page arena)")
+
     def _match_locked(self, row: list) -> tuple[int, list]:
         """(matched token count, path nodes) under the store lock."""
+        self._maybe_flush_stale_locked()
         cap = self._target_len(len(row))
         m, node, path = 0, self._root, []
         while m < cap:
@@ -188,7 +254,12 @@ class PrefixStore:
         self.stats_counters.record_request(matched)
         try:
             if matched >= target:
-                self._ensure_assembled(row, path[:target // self.block])
+                if self.pool is None:
+                    self._ensure_assembled(row,
+                                           path[:target // self.block])
+                # paged full hit: nothing to do here — the pages are
+                # already in the arena and the engine acquires them by
+                # refcount bump (acquire_pages); no assembly, no copy
             else:
                 self._extend(row, target)
             return target
@@ -196,6 +267,38 @@ class PrefixStore:
             log.error("prefix store routing failed (serving without "
                       "reuse): %s", e)
             return 0
+
+    def acquire_pages(self, tokens):
+        """Paged-mode hit handout: resolve a block-aligned prefix to its
+        arena pages with one pool ref taken PER PAGE for the caller (the
+        zero-copy path — the engine's row shares the store's physical
+        pages; releasing them is a refcount drop). Returns ``(page_ids,
+        prefix_len)`` or None when any block is missing (evicted since
+        routing, or an explicit client prefix that never walked this
+        tree) — the caller then serves through the dense fallback.
+        Retain happens under the store lock, so a concurrent LRU sweep
+        cannot release a page between the match and the bump."""
+        if self.pool is None:
+            return None
+        try:
+            row = [int(t) for t in tokens]
+        except (TypeError, ValueError):
+            return None
+        if not row or len(row) % self.block:
+            return None
+        with self._lock:
+            self._maybe_flush_stale_locked()
+            node, m, pids = self._root, 0, []
+            while m < len(row):
+                child = node.children.get(tuple(row[m:m + self.block]))
+                if child is None or child.page_id is None:
+                    return None
+                child.last_used = next(self._clock)
+                pids.append(child.page_id)
+                node = child
+                m += self.block
+            self.pool.retain(pids)
+        return pids, m
 
     # -- assembly / extension ------------------------------------------------
 
@@ -213,6 +316,7 @@ class PrefixStore:
         with self.server._mesh_ctx():
             cache = concat_cache_blocks(cfg, [n.kv for n in path],
                                         cfg.max_len)
+        self.stats_counters.record_assembly(_cache_bytes(cache))
         self.server.register_prefix(key, cache, m)
 
     def _extend(self, row: list, target: int) -> None:
@@ -223,26 +327,55 @@ class PrefixStore:
         inserted the very blocks this thread wanted."""
         key = self.server._prefix_key(row[:target])
         while True:
-            owner, waiter = False, None
+            owner, waiter, pinned = False, None, []
             with self._lock:
                 matched, path = self._match_locked(row)
+                if matched < target and self.pool is not None:
+                    # PIN the matched pages for the walk, under the same
+                    # lock that validated them: a concurrent LRU sweep
+                    # could otherwise release-and-reuse a matched page
+                    # between here and the walk's arena snapshot, and
+                    # the gather would silently read another row's KV.
+                    # An already-evicted node (page_id None) truncates
+                    # the usable prefix — the walk just re-prefills it.
+                    # Only the ids in ``pinned`` were retained; releasing
+                    # anything else would strip the STORE's own refs
+                    # (the double-free the serve drive caught).
+                    keep = []
+                    for n in path:
+                        if n.page_id is None:
+                            break
+                        keep.append(n)
+                    path = keep
+                    matched = len(keep) * self.block
+                    pinned = [n.page_id for n in keep]
+                    self.pool.retain(pinned)
                 if matched < target:
                     waiter = self._inflight.get(key)
                     if waiter is None:
                         self._inflight[key] = threading.Event()
                         owner = True
             if matched >= target:
-                self._ensure_assembled(row, path[:target // self.block])
+                # a full match never pins (the pin block is gated on
+                # matched < target) — nothing to drop here
+                if self.pool is None:
+                    self._ensure_assembled(row,
+                                           path[:target // self.block])
                 return
             if owner:
                 try:
                     self._walk(row, matched, target, path)
                 finally:
+                    if pinned:
+                        self.pool.release(pinned)
                     with self._lock:
                         event = self._inflight.pop(key, None)
                     if event is not None:
                         event.set()
                 return
+            if pinned:
+                # not the owner: drop the pins before waiting
+                self.pool.release(pinned)
             if not waiter.wait(timeout=300.0):
                 raise RuntimeError(
                     f"prefix walk for key {key[:8]}... owned by another "
@@ -269,6 +402,22 @@ class PrefixStore:
                 prompt_op, _ = server._pad_rows([row[:fw]], [fw], 1, fw)
                 cache = pf(server.params, prompt_op, jnp.int32(fw))
                 pos = fw
+            elif self.pool is not None:
+                # paged: the matched blocks live in arena pages — gather
+                # them into the walk's contiguous working cache (a
+                # transient buffer for the ext programs, never
+                # registered; the hit path itself stays zero-copy)
+                import numpy as np
+
+                gather = server._paged_gather_fn(
+                    self.pool.n_pages, self.pool.page, cfg.max_len)
+                tbl = np.zeros((1, cfg.max_len // bk), np.int32)
+                tbl[0, :len(path)] = [n.page_id for n in path]
+                with self.pool.arena_lock:
+                    arena = self.pool.ensure_arena()
+                    cache = gather(arena, jnp.asarray(tbl),
+                                   jnp.int32(matched))
+                pos = matched
             else:
                 key_m = server._prefix_key(row[:matched])
                 entry = server.get_prefix(key_m)
@@ -279,6 +428,8 @@ class PrefixStore:
                 else:
                     cache = concat_cache_blocks(
                         cfg, [n.kv for n in path], cfg.max_len)
+                    self.stats_counters.record_assembly(
+                        _cache_bytes(cache))
                 pos = matched
             # full-width wide chunks where they fit, block-width tail.
             # A wide write must stay inside max_len: the ext program
@@ -304,6 +455,13 @@ class PrefixStore:
                     pos += bk
             new_blocks = [slice_cache_blocks(cache, p, bk)
                           for p in range(matched, target, bk)]
+        if self.pool is not None:
+            # paged insertion: each fresh block gets its own arena page
+            # (store-owned ref); the full-window walk cache is a
+            # TRANSIENT buffer — nothing registers, so the store never
+            # holds an assembled duplicate
+            self._insert_paged(row, matched, new_blocks)
+            return
         server.register_prefix(server._prefix_key(row[:target]), cache,
                                target)
         self._insert(row, matched, new_blocks)
@@ -333,10 +491,119 @@ class PrefixStore:
                 m += self.block
             self._evict_locked()
 
+    def _insert_paged(self, row: list, start: int,
+                      new_blocks: list) -> None:
+        """Paged-mode insertion: write each fresh block slice into its
+        own arena page (``_page_write_fn``) and attach page-carrying
+        nodes under the matched path. The page writes — including the
+        write program's first-use compile — are STAGED before taking
+        the store lock, so concurrent route()/match_len()/
+        acquire_pages() callers never stall behind a cold insert's
+        device work. Out-of-pages asks the pool's reclaim hook (this
+        store's cold unshared leaves) via ``alloc``; a genuinely full
+        arena just caches fewer blocks — fail open, the request already
+        has its KV in the walk cache."""
+        import jax.numpy as jnp
+
+        from lambdipy_tpu.runtime.pagepool import PagesExhausted
+
+        server, pool, bk = self.server, self.pool, self.block
+        write = server._page_write_fn(pool.n_pages, pool.page)
+        gen = pool.arena_generation
+        staged: list[int] = []
+        for blk in new_blocks:
+            try:
+                pid = pool.alloc(1, tokens=bk, record_shed=False)[0]
+            except PagesExhausted:
+                break  # cache less; `sheds` meters admissions only
+            except Exception as e:  # noqa: BLE001 — injected fault etc.
+                log.error("prefix page alloc failed (caching less): %s",
+                          e)
+                break
+            with pool.arena_lock:
+                arena = pool.ensure_arena()
+                pool.arena = write(arena, jnp.int32(pid), blk)
+            staged.append(pid)
+        attached: set[int] = set()
+        with self._lock:
+            self._maybe_flush_stale_locked()
+            if pool.arena_generation != gen:
+                # the arena reset mid-stage: the staged content is gone
+                pool.release(staged)
+                return
+            node, m = self._root, 0
+            while m < start + len(staged) * bk:
+                tok_key = tuple(row[m:m + bk])
+                child = node.children.get(tok_key)
+                if child is None:
+                    idx = (m - start) // bk
+                    if m < start or idx >= len(staged):
+                        # a racer evicted part of our base path: give up
+                        # the insert — the KV is already serving
+                        break
+                    child = _Node(node, tok_key, None, pool.page_bytes,
+                                  page_id=staged[idx])
+                    node.children[tok_key] = child
+                    self.stats_counters.record_insert(1, child.nbytes)
+                    attached.add(idx)
+                child.last_used = next(self._clock)
+                node = child
+                m += bk
+            self._evict_locked()
+        leftovers = [pid for i, pid in enumerate(staged)
+                     if i not in attached]
+        if leftovers:
+            # a racer already held those nodes (its pages serve), or the
+            # base path vanished: our staged duplicates return
+            pool.release(leftovers)
+
+    def reclaim_pages(self, n: int) -> int:
+        """Pool out-of-pages hook: release up to ``n`` cold UNSHARED
+        leaf pages so live admissions never shed behind a cache — a
+        request's KV outranks a cached prefix nobody is using right
+        now. Returns pages actually freed (shared/hot pages stay)."""
+        with self._lock:
+            return self._sweep_unshared_locked(n)
+
+    def _sweep_unshared_locked(self, n: int) -> int:
+        """Release up to ``n`` LRU leaves whose page only the store
+        holds, in ONE tree pass with the pool refcounts snapshotted
+        once — a per-page rescan (O(tree) each, a pool-lock round-trip
+        per leaf) turned page pressure into admission-latency spikes.
+        A parent whose whole chain became evictable frees on the next
+        sweep (pressure recurs; convergence does not need cascading
+        here)."""
+        refs = self.pool.snapshot_refs()
+        leaves = [node for node in self._iter_nodes()
+                  if not node.children and node.page_id is not None
+                  and refs.get(node.page_id, 0) == 1]
+        leaves.sort(key=lambda node: node.last_used)
+        freed = 0
+        for victim in leaves[:max(0, int(n))]:
+            victim.parent.children.pop(victim.token_key, None)
+            self.stats_counters.record_evict(1, victim.nbytes)
+            self.pool.release([victim.page_id])
+            victim.page_id = None
+            freed += 1
+        return freed
+
     def _evict_locked(self) -> None:
         """LRU leaf eviction until the budget holds (leaves only: an
         interior node's KV is position-prefixed by its parents, so
-        dropping it would orphan every descendant block)."""
+        dropping it would orphan every descendant block). Paged mode is
+        REFCOUNT-AWARE: a leaf whose page a live row still shares is
+        skipped — it is hot by definition, and releasing it would only
+        drop the store's ref without freeing a page; the sweep retries
+        it once the sharing rows have retired."""
+        if self.pool is not None:
+            while True:
+                over = self.stats_counters.report()["bytes"] \
+                    - self.budget_bytes
+                if over <= 0:
+                    return
+                need = -(-over // max(1, self.pool.page_bytes))
+                if not self._sweep_unshared_locked(need):
+                    return
         while self.stats_counters.report()["bytes"] > self.budget_bytes:
             leaves = [n for n in self._iter_nodes()
                       if not n.children and n.kv is not None]
@@ -360,6 +627,11 @@ class PrefixStore:
         out = self.stats_counters.report()
         out["block"] = self.block
         out["budget_bytes"] = self.budget_bytes
+        if self.pool is not None:
+            # paged mode: block bytes above are arena pages the store
+            # holds a ref on; shares/refcounts live in the pool's own
+            # batching.page_pool block
+            out["paged"] = True
         # the assembled full-window caches live in the SERVER's
         # count-bounded prefix LRU (prefix_cache_max), OUTSIDE this
         # budget — surface their real footprint so an operator sizing

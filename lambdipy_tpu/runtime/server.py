@@ -34,6 +34,7 @@ from pathlib import Path
 
 from lambdipy_tpu.runtime.continuous import RequestCancelled
 from lambdipy_tpu.runtime.loader import BootReport, load_bundle
+from lambdipy_tpu.runtime.pagepool import PagesExhausted
 from lambdipy_tpu.runtime.metrics import LatencyStats
 from lambdipy_tpu.sched import (
     SchedConfig,
@@ -485,6 +486,17 @@ class BundleServer:
                             "cancelled", cls)
                         self._send_shed(Shed(503, str(e), 1.0))
                         return
+                    except PagesExhausted as e:
+                        # the paged KV arena is transiently full —
+                        # backpressure priced by the pool's own release
+                        # cadence, exactly like a queue-depth shed
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "kv_pages", cls)
+                        self._send_shed(
+                            Shed(503, "kv_pages", e.retry_after_s))
+                        return
                     except Exception as e:  # handler bug or bad payload shape
                         server_self.stats.record_error()
                         log_event(log, "invoke failed", error=str(e),
@@ -541,6 +553,17 @@ class BundleServer:
                         server_self.sched.admission.count_shed(
                             "cancelled", cls)
                         self._send_shed(Shed(503, str(e), 1.0), openai=True)
+                        return
+                    except PagesExhausted as e:
+                        # transiently full KV page arena: priced
+                        # backpressure, not a server fault
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "kv_pages", cls)
+                        self._send_shed(
+                            Shed(503, "kv_pages", e.retry_after_s),
+                            openai=True)
                         return
                     except Exception as e:
                         server_self.stats.record_error()

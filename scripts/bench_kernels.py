@@ -145,6 +145,52 @@ def bench_decode_attention(rtt: float):
         print(json.dumps(out))
 
 
+def bench_paged_decode_attention(rtt: float):
+    """The paged-indirection cost question: the block-table decode
+    kernel (scalar-prefetch table lookup per KV page) vs the contiguous
+    clamped-index blocked kernel at the same 8B decode shapes and
+    active lengths. Tables here are the identity layout (page j of row
+    r at arena slot r*nb + j) so both kernels read the same bytes —
+    any delta is pure indirection overhead, the number that decides
+    whether paged mode costs decode latency on chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.ops.decode_attention import (
+        blocked_decode_attention, paged_blocked_decode_attention)
+
+    b, h, kvh, d, t, page = 8, 32, 8, 128, 8192, 128
+    nb = t // page
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, 1, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, t, kvh, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, t, kvh, d), jnp.bfloat16)
+    # the same KV re-laid out page-major, plus the identity block table
+    k_pages = k.reshape(b * nb, page, kvh, d)
+    v_pages = v.reshape(b * nb, page, kvh, d)
+    tables = jnp.arange(b * nb, dtype=jnp.int32).reshape(b, nb)
+    iters = 50
+    for alen in (512, 2048, 8192):
+        lens = jnp.full((b,), alen, jnp.int32)
+        contiguous = _scan_many(
+            lambda c: blocked_decode_attention(c, k, v, lens,
+                                               block_k=page,
+                                               interpret=False), iters)
+        paged = _scan_many(
+            lambda c: paged_blocked_decode_attention(
+                c, k_pages, v_pages, tables, lens, interpret=False),
+            iters)
+        out = {"op": "paged_decode_attention", "active_len": alen,
+               "window": t, "page": page, "batch": b, "iters": iters}
+        for name, fn in (("contiguous_ms", contiguous),
+                         ("paged_ms", paged)):
+            out[name] = round(_amortized_ms(lambda: fn(q), rtt, iters), 3)
+        out["indirection_overhead"] = round(
+            out["paged_ms"] / max(out["contiguous_ms"], 1e-4) - 1.0, 4)
+        print(json.dumps(out))
+
+
 def bench_int8_matmul(rtt: float):
     import jax
     import jax.numpy as jnp
@@ -195,6 +241,7 @@ def main() -> int:
                       "rtt_ms": round(rtt, 2)}))
     bench_attention(rtt)
     bench_decode_attention(rtt)
+    bench_paged_decode_attention(rtt)
     bench_int8_matmul(rtt)
     return 0
 

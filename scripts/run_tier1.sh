@@ -32,7 +32,9 @@
 # phase 8 the FLEET-BOUNDARY chaos matrix (bench.py --chaos-fleet:
 # router-side network faults — dropped connections, mid-body deaths,
 # latency spikes, flapping probes — plus a fleet-wide shed burst the
-# router's spill queue must absorb with zero client-visible errors).
+# router's spill queue must absorb with zero client-visible errors);
+# phase 9 the PAGED-KV sweep (bench.py --paged: bitwise paged-vs-dense
+# parity, zero-copy prefix hits, token-bounded capacity margin).
 #
 # Every phase prints its wall-clock so the budget breakdown is visible
 # in the log (ROADMAP open item: phase 2 runs close to its 870 s cap).
@@ -59,9 +61,9 @@ phase_end "phase 1"
 # tiny_server (one compiled-program cache) and are the wall-clock-heavy
 # half of the suite
 ENGINE_SHARD="tests/test_continuous.py tests/test_continuous_pipeline.py \
-tests/test_faults.py tests/test_prefixstore.py \
-tests/test_decode_attention.py tests/test_runtime.py \
-tests/test_fleet.py tests/test_e2e.py"
+tests/test_faults.py tests/test_prefixstore.py tests/test_paged.py \
+tests/test_pagepool.py tests/test_decode_attention.py \
+tests/test_runtime.py tests/test_fleet.py tests/test_e2e.py"
 
 set -o pipefail
 phase_begin "phase 2a: tier-1 engine/serving shard"
@@ -157,4 +159,19 @@ if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 8"
+
+# Phase 9: paged-KV smoke — bench.py --paged exits nonzero if the paged
+# engine's outputs diverge bitwise from the dense path (cold, prefix
+# hits, sampled, streamed, concurrent, depths 1-2), if a prefix hit
+# pays any assembly copy (assembly_bytes_peak must stay 0 while the
+# dense comparison re-assembles), or if page accounting fails to admit
+# strictly more mixed-length rows than window accounting in the same
+# HBM budget (the margin prints on stderr).
+phase_begin "phase 9: paged KV sweep (bench.py --paged)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --paged; then
+    echo "FATAL: bench.py --paged sweep failed" >&2
+    exit 1
+fi
+phase_end "phase 9"
 exit 0

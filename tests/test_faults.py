@@ -97,6 +97,71 @@ def test_fault_plan_hang_releases_and_raises():
         plan2.check("transport", interrupt=aborted)
 
 
+def test_fault_plan_accepts_page_alloc_site():
+    p = FaultPlan.from_spec("page_alloc:exception@seg=2,n=3")
+    assert p.describe() == ["page_alloc:exception@seg=2,n=3"]
+    p.check("page_alloc")                       # seg 1: clean
+    with pytest.raises(InjectedFault) as exc:
+        p.check("page_alloc")
+    assert exc.value.fault_site == "page_alloc"
+
+
+def test_injected_page_alloc_failure_sheds_one_row_only(tiny_server):
+    """A page_alloc fault mid-admission sheds THAT row as priced
+    backpressure (PagesExhausted, retry_after_s attached) while rows
+    already in flight finish bitwise and later admissions serve — no
+    engine wedge, no lost rows, failure attributed under ``page_alloc``
+    in the fault stats."""
+    from lambdipy_tpu.models.llama import init_page_arena, page_kv_bytes
+    from lambdipy_tpu.runtime.pagepool import (PagePool, PagesExhausted,
+                                               page_width)
+
+    cfg = tiny_server.model.cfg
+    page = page_width(cfg.max_len, 16)
+    n_pages = 4 * (cfg.max_len // page) + 1
+    pool = PagePool(n_pages=n_pages, page=page,
+                    page_bytes=page_kv_bytes(cfg, page),
+                    make_arena=lambda: init_page_arena(cfg, n_pages,
+                                                       page))
+    # the 2nd allocator call fails: the in-flight first row must not
+    # notice; the engine's armed plan drives the pool site (ctor wiring)
+    eng = ContinuousBatcher(
+        tiny_server, slots=4, segment=8, page_pool=pool,
+        faults=FaultPlan.from_spec("page_alloc:exception@seg=2"))
+    assert pool.faults is eng.faults
+    rows = [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+    solo = [tiny_server.generate(r, max_new_tokens=16) for r in rows]
+
+    out0 = {}
+    started = threading.Event()
+
+    def first():
+        started.set()
+        out0["v"] = eng.generate(rows[0], max_new_tokens=16)
+
+    t = threading.Thread(target=first)
+    t.start()
+    started.wait()
+    time.sleep(0.05)        # let row 0 enter the engine
+    with pytest.raises(PagesExhausted) as exc:
+        eng.generate(rows[1], max_new_tokens=16)
+    assert exc.value.retry_after_s > 0
+    t.join()
+    np.testing.assert_array_equal(out0["v"], solo[0])   # no lost row
+    # the engine never wedged and keeps serving
+    assert not eng.wedged
+    np.testing.assert_array_equal(
+        eng.generate(rows[2], max_new_tokens=16), solo[2])
+    rep = eng.fault_stats.report()
+    assert rep["failures"].get("page_alloc") == 1, rep
+    with eng._lock:
+        while eng._engine_running:
+            eng._lock.wait(0.05)
+    pool.check_invariants()
+    st = pool.stats()
+    assert st["pages_free"] == st["pages_total"], st
+
+
 # -- replay-on-restart (the acceptance-criteria parity claim) ----------------
 
 

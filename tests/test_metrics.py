@@ -227,7 +227,8 @@ def test_prefix_cache_stats_counters():
     st = PrefixCacheStats()
     assert st.report() == {"hits": 0, "misses": 0, "hit_rate": 0.0,
                            "hit_tokens": 0, "evictions": 0, "bytes": 0,
-                           "blocks": 0}
+                           "blocks": 0, "assemblies": 0,
+                           "assembly_bytes_peak": 0}
     st.record_request(0)        # miss
     st.record_request(64)       # hit, 64 reused tokens
     st.record_request(32)
@@ -240,3 +241,64 @@ def test_prefix_cache_stats_counters():
     assert rep["hit_tokens"] == 96
     assert rep["blocks"] == 2 and rep["bytes"] == 8192
     assert rep["evictions"] == 1
+
+
+def test_prefix_cache_assembly_peak_gauge():
+    """``assembly_bytes_peak`` is ALWAYS reported — 0 until an assembly
+    happens, so the paged path's zero-copy claim is an observable fact
+    rather than a missing key — and tracks the LARGEST single assembled
+    cache, not a running sum."""
+    st = PrefixCacheStats()
+    rep = st.report()
+    assert rep["assembly_bytes_peak"] == 0 and rep["assemblies"] == 0
+    st.record_assembly(1 << 20)
+    st.record_assembly(1 << 18)          # smaller: peak must not move
+    rep = st.report()
+    assert rep["assemblies"] == 2
+    assert rep["assembly_bytes_peak"] == 1 << 20
+    st.record_assembly(1 << 21)
+    assert st.report()["assembly_bytes_peak"] == 1 << 21
+
+
+def test_page_pool_stats_counters():
+    """The paged-KV allocator's counter block (``batching.page_pool``):
+    alloc/release count calls AND pages, shares count refcount bumps
+    (each one a zero-copy prefix-hit page), sheds count priced
+    PagesExhausted refusals."""
+    from lambdipy_tpu.runtime.metrics import PagePoolStats
+
+    st = PagePoolStats()
+    assert st.report() == {"allocs": 0, "alloc_pages": 0, "releases": 0,
+                           "release_pages": 0, "shares": 0, "sheds": 0}
+    st.record_alloc(3)
+    st.record_alloc(1)
+    st.record_release(2)
+    st.record_share(4)
+    st.record_shed()
+    rep = st.report()
+    assert rep["allocs"] == 2 and rep["alloc_pages"] == 4
+    assert rep["releases"] == 1 and rep["release_pages"] == 2
+    assert rep["shares"] == 4 and rep["sheds"] == 1
+
+
+def test_page_pool_stats_concurrent():
+    import threading
+
+    from lambdipy_tpu.runtime.metrics import PagePoolStats
+
+    st = PagePoolStats()
+
+    def work():
+        for _ in range(200):
+            st.record_alloc(2)
+            st.record_share()
+            st.record_release(2)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = st.report()
+    assert rep["alloc_pages"] == rep["release_pages"] == 1600
+    assert rep["shares"] == 800

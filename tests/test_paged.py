@@ -1,0 +1,458 @@
+"""Paged KV engine (runtime/pagepool.py + the continuous engine's
+block-table dispatch): bitwise parity with the dense path, zero-copy
+prefix hits, and page lifecycle under traffic.
+
+The acceptance bar mirrors PRs 2/3/5: the dense contiguous engine is
+the reference and paged outputs must equal it BITWISE — greedy and
+seeded-sampled, cold rows and prefix hits, streamed and not, at
+pipeline depths 1 and 2, under concurrent traffic."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models.llama import init_page_arena, page_kv_bytes
+from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+from lambdipy_tpu.runtime.pagepool import PagePool, page_width
+from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+# tiny_server: the session-scoped shared LlamaServer from conftest.py
+
+
+def mk_paged(server, *, slots=4, segment=8, n_windows=None, depth=1,
+             block=16, **kw):
+    cfg = server.model.cfg
+    page = page_width(cfg.max_len, block)
+    n_pages = (n_windows or slots) * (cfg.max_len // page) + 1
+    pool = PagePool(n_pages=n_pages, page=page,
+                    page_bytes=page_kv_bytes(cfg, page),
+                    make_arena=lambda: init_page_arena(cfg, n_pages,
+                                                       page))
+    eng = ContinuousBatcher(server, slots=slots, segment=segment,
+                            pipeline_depth=depth, page_pool=pool, **kw)
+    return eng, pool
+
+
+def drain(eng):
+    with eng._lock:
+        while eng._engine_running:
+            eng._lock.wait(0.05)
+
+
+# -- bitwise parity -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_concurrent_paged_matches_solo(tiny_server, depth):
+    """Staggered concurrent greedy requests through the paged engine are
+    bitwise their solo outputs, rows actually fuse, and every page
+    returns to the pool at idle."""
+    eng, pool = mk_paged(tiny_server, slots=8, depth=depth)
+    prompts = [[1 + i, 2 + i, 3 + i, 5] for i in range(8)]
+    solo = [tiny_server.generate(p, max_new_tokens=16) for p in prompts]
+    results = [None] * 8
+
+    def run(i):
+        time.sleep(0.02 * i)
+        results[i] = eng.generate(prompts[i], max_new_tokens=16)
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        list(ex.map(run, range(8)))
+    for i in range(8):
+        np.testing.assert_array_equal(results[i], solo[i],
+                                      err_msg=f"request {i} diverged")
+    if eng.stats()["rows_in_segments"] <= eng.stats()["segments_run"]:
+        # heavy machine load can serialize the staggered arrivals so no
+        # rows overlapped; an all-at-once burst on the same engine fuses
+        # deterministically (admissions outpace the first prefill) — the
+        # cumulative counters then prove paged rows really share steps
+        with ThreadPoolExecutor(max_workers=8) as ex:
+            outs = list(ex.map(
+                lambda p: eng.generate(p, max_new_tokens=16), prompts))
+        for out, ref in zip(outs, solo):
+            np.testing.assert_array_equal(out, ref)
+    stats = eng.stats()
+    assert stats["rows_in_segments"] > stats["segments_run"], stats
+    drain(eng)
+    pool.check_invariants()
+    st = pool.stats()
+    assert st["pages_free"] == st["pages_total"], st
+    assert st["alloc_pages"] > 0 and st["release_pages"] == st["alloc_pages"]
+
+
+def test_sampled_rows_match_solo(tiny_server):
+    """Seeded-sampled paged rows reproduce their solo chains exactly
+    while sharing the batch with greedy traffic."""
+    eng, pool = mk_paged(tiny_server)
+    kw = dict(temperature=0.9, top_k=24, seed=13)
+    row_s, row_g = [3, 1, 4, 1, 5], [2, 7, 1, 8]
+    solo_s = tiny_server.generate(row_s, max_new_tokens=12, **kw)
+    solo_g = tiny_server.generate(row_g, max_new_tokens=12)
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        f1 = ex.submit(eng.generate, row_s, max_new_tokens=12, **kw)
+        f2 = ex.submit(eng.generate, row_g, max_new_tokens=12)
+        np.testing.assert_array_equal(f1.result(), solo_s)
+        np.testing.assert_array_equal(f2.result(), solo_g)
+    drain(eng)
+    pool.check_invariants()
+
+
+def test_streamed_paged_matches_nonstreamed(tiny_server):
+    eng, pool = mk_paged(tiny_server)
+    row = [6, 5, 4, 3]
+    solo = tiny_server.generate(row, max_new_tokens=16)
+    chunks = list(eng.generate_stream(row, max_new_tokens=16))
+    np.testing.assert_array_equal(
+        np.concatenate(chunks, axis=1)[:, :16], solo)
+    drain(eng)
+    assert pool.stats()["pages_free"] == pool.stats()["pages_total"]
+
+
+def test_solo_prefill_pack_path(tiny_server):
+    """group_prefill_max=0 forces the request-thread prefill: the dense
+    1-row carry scatters into the joiner's pages bitwise."""
+    eng, pool = mk_paged(tiny_server, group_prefill_max=0)
+    rows = [[9, 8, 7, 6, 5], [1, 2, 3]]
+    solo = [tiny_server.generate(r, max_new_tokens=12) for r in rows]
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        outs = list(ex.map(
+            lambda r: eng.generate(r, max_new_tokens=12), rows))
+    for o, s in zip(outs, solo):
+        np.testing.assert_array_equal(o, s)
+    drain(eng)
+    pool.check_invariants()
+
+
+def test_window_bucketing_off_matches(tiny_server):
+    eng, pool = mk_paged(tiny_server, window_bucketing=False)
+    row = [4, 4, 2, 1]
+    solo = tiny_server.generate(row, max_new_tokens=16)
+    np.testing.assert_array_equal(
+        eng.generate(row, max_new_tokens=16), solo)
+    drain(eng)
+
+
+def test_eos_truncation_matches(tiny_server):
+    """Host-side eos latch parity rides the paged path unchanged."""
+    free = tiny_server.generate([5, 6, 7, 8], max_new_tokens=12)[0]
+    eos = int(free[3])
+    solo = tiny_server.generate([5, 6, 7, 8], max_new_tokens=12,
+                                eos_id=eos)
+    eng, pool = mk_paged(tiny_server)
+    np.testing.assert_array_equal(
+        eng.generate([5, 6, 7, 8], max_new_tokens=12, eos_id=eos), solo)
+    drain(eng)
+    assert pool.stats()["pages_free"] == pool.stats()["pages_total"]
+
+
+# -- zero-copy prefix hits ----------------------------------------------------
+
+
+def make_paged_prefix(server, eng, pool, block=16):
+    store = PrefixStore(server, budget_mb=64, pool=pool)
+    eng.prefix_pages_fn = store.acquire_pages
+    return store
+
+
+def test_prefix_hit_is_zero_copy_and_bitwise(tiny_server):
+    """The tentpole claim end to end: a radix hit on the paged engine
+    costs refcount bumps (observed > 1 on the live pool), performs NO
+    assembly (assembly_bytes_peak stays 0), and the routed outputs are
+    bitwise the unrouted solo ones — cold walk and hits alike."""
+    eng, pool = mk_paged(tiny_server, slots=4)
+    store = make_paged_prefix(tiny_server, eng, pool)
+    shared = list(range(1, 33))                     # 2 x 16-token blocks
+    prompts = [shared + [50 + i, 60 + i, 70 + i] for i in range(4)]
+    solo = [tiny_server.generate(p, max_new_tokens=12) for p in prompts]
+
+    def routed(i):
+        row = prompts[i]
+        m = store.route(row)
+        assert m == 32
+        return eng.generate(np.asarray(row[m:], np.int32),
+                            max_new_tokens=12,
+                            prefix=np.asarray(row[:m], np.int32))
+
+    np.testing.assert_array_equal(routed(0), solo[0])   # cold walk
+    max_ref = 1
+    done = []
+
+    def burst():
+        with ThreadPoolExecutor(max_workers=3) as ex:
+            done.extend(ex.map(routed, range(1, 4)))
+
+    t = threading.Thread(target=burst)
+    t.start()
+    while t.is_alive():
+        max_ref = max(max_ref, pool.stats()["max_refcount"])
+        time.sleep(0.001)
+    t.join()
+    for o, s in zip(done, solo[1:]):
+        np.testing.assert_array_equal(o, s)
+
+    st = store.stats()
+    assert st["paged"] and st["hits"] == 3 and st["blocks"] == 2
+    assert st["assemblies"] == 0 and st["assembly_bytes_peak"] == 0
+    ps = pool.stats()
+    assert ps["shares"] >= 8        # 2 pages x (3 hits + cold acquire)
+    drain(eng)
+    pool.check_invariants()
+    # idle: only the store's 2 prefix pages stay live, everything else
+    # returned to the free list
+    ps = pool.stats()
+    assert ps["pages_live"] == 2 and ps["refcount_histogram"] == {"1": 2}
+    if max_ref <= 1:
+        # polling may miss the decode window on a fast machine — prove
+        # sharing deterministically: store ref + an explicit acquire
+        acq = store.acquire_pages(shared)
+        assert acq is not None and acq[1] == 32
+        assert pool.stats()["max_refcount"] == 2
+        pool.release(acq[0])
+    else:
+        assert max_ref > 1
+
+
+def test_concurrent_cold_burst_dedups_without_double_free(tiny_server):
+    """Regression (caught by the serve drive): N concurrent COLD
+    requests for the same prefix collapse to one walk via the inflight
+    dedup — the waiter threads must NOT strip the store's page refs on
+    their re-match (that freed live pages under the store and corrupted
+    later admissions). All outputs bitwise, invariants hold, a second
+    wave hits the now-cached pages, and at idle only the store's refs
+    remain."""
+    eng, pool = mk_paged(tiny_server, slots=4)
+    store = make_paged_prefix(tiny_server, eng, pool)
+    shared = list(range(61, 93))                    # 2 x 16-token blocks
+    prompts = [shared + [10 + i, 20 + i] for i in range(4)]
+    solo = [tiny_server.generate(p, max_new_tokens=10) for p in prompts]
+
+    def routed(i):
+        row = prompts[i]
+        m = store.route(row)
+        assert m == 32
+        return eng.generate(np.asarray(row[m:], np.int32),
+                            max_new_tokens=10,
+                            prefix=np.asarray(row[:m], np.int32))
+
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        outs = list(ex.map(routed, range(4)))
+    for o, s in zip(outs, solo):
+        np.testing.assert_array_equal(o, s)
+    pool.check_invariants()
+    st = store.stats()
+    # arrival timing decides how many of the 4 raced the cold walk vs
+    # matched after it, but dedup means exactly ONE walk inserted blocks
+    assert st["hits"] + st["misses"] == 4 and st["blocks"] == 2, st
+    # second wave: the cached pages serve as hits now
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        outs = list(ex.map(routed, range(4)))
+    for o, s in zip(outs, solo):
+        np.testing.assert_array_equal(o, s)
+    assert store.stats()["hits"] >= 4
+    drain(eng)
+    pool.check_invariants()
+    ps = pool.stats()
+    assert ps["pages_live"] == 2 and ps["refcount_histogram"] == {"1": 2}
+
+
+def test_prefix_hit_sampled_and_streamed(tiny_server):
+    eng, pool = mk_paged(tiny_server)
+    store = make_paged_prefix(tiny_server, eng, pool)
+    shared = list(range(101, 117))                  # one block
+    row = shared + [7, 8, 9]
+    kw = dict(temperature=0.7, top_k=16, seed=5)
+    solo_s = tiny_server.generate(row, max_new_tokens=10, **kw)
+    solo_g = tiny_server.generate(row, max_new_tokens=10)
+    m = store.route(row)
+    assert m == 16
+    pfx, suf = np.asarray(row[:m], np.int32), np.asarray(row[m:], np.int32)
+    np.testing.assert_array_equal(
+        eng.generate(suf, max_new_tokens=10, prefix=pfx, **kw), solo_s)
+    chunks = list(eng.generate_stream(suf, max_new_tokens=10, prefix=pfx))
+    np.testing.assert_array_equal(
+        np.concatenate(chunks, axis=1)[:, :10], solo_g)
+    assert store.stats()["assembly_bytes_peak"] == 0
+    drain(eng)
+    pool.check_invariants()
+
+
+def test_acquire_pages_unknown_prefix_falls_back(tiny_server):
+    """An explicit client prefix that never walked the paged tree (or
+    was evicted) serves through the dense fallback — acquire returns
+    None, the engine declines, and the request still completes with
+    parity through server.generate."""
+    eng, pool = mk_paged(tiny_server)
+    store = make_paged_prefix(tiny_server, eng, pool)
+    prefix = list(range(1, 17))
+    row = prefix + [2, 3]
+    assert store.acquire_pages(prefix) is None
+    solo = tiny_server.generate(row, max_new_tokens=8)
+    out = eng.generate(np.asarray(row[16:], np.int32), max_new_tokens=8,
+                       prefix=np.asarray(prefix, np.int32))
+    np.testing.assert_array_equal(out, solo)
+
+
+def test_refcount_aware_eviction(tiny_server):
+    """The LRU sweep only releases pages the store alone holds: a page a
+    live acquisition shares survives the sweep; releasing the share
+    makes it evictable."""
+    eng, pool = mk_paged(tiny_server)
+    store = make_paged_prefix(tiny_server, eng, pool)
+    rowA = list(range(1, 17)) + [99]
+    rowB = list(range(201, 217)) + [98]
+    assert store.route(rowA) == 16
+    assert store.route(rowB) == 16
+    acq = store.acquire_pages(rowA[:16])
+    assert acq is not None
+    # squeeze the budget to zero: only B's (unshared) page may release
+    store.budget_bytes = 0
+    with store._lock:
+        store._evict_locked()
+    assert store.acquire_pages(rowB[:16]) is None       # evicted
+    held = store.acquire_pages(rowA[:16])                # survived
+    assert held is not None
+    pool.release(held[0])
+    pool.release(acq[0])
+    # now A is unshared -> the sweep can release it
+    with store._lock:
+        store._evict_locked()
+    assert store.acquire_pages(rowA[:16]) is None
+    pool.check_invariants()
+    assert pool.stats()["pages_live"] == 0
+
+
+def test_paged_prefix_row_replays_bitwise_after_engine_failure(
+        tiny_server):
+    """Fault isolation composes with paged prefixes: an engine failure
+    mid-decode resets the arena (on an async backend the published
+    arena may be the failed computation's own output) and the replayed
+    prefix-hit row transparently re-prefills as a FULL cold row through
+    its kept pages — the caller still sees its bitwise solo output. The
+    store's tree flushes on the generation bump, so afterwards the
+    arena drains to fully free and a re-route walks cold again."""
+    from lambdipy_tpu.runtime.faults import FaultPlan
+
+    eng, pool = mk_paged(tiny_server, slots=4, segment=4)
+    eng.faults = FaultPlan.from_spec("segment_fetch:exception@seg=1")
+    store = make_paged_prefix(tiny_server, eng, pool)
+    shared = list(range(1, 33))
+    row = shared + [41, 42, 43]
+    solo = tiny_server.generate(row, max_new_tokens=12)
+    m = store.route(row)
+    assert m == 32
+    gen0 = pool.arena_generation
+    out = eng.generate(np.asarray(row[m:], np.int32), max_new_tokens=12,
+                       prefix=np.asarray(row[:m], np.int32))
+    np.testing.assert_array_equal(out, solo)
+    faults = eng.stats()["faults"]
+    assert faults["failures"].get("segment_fetch") == 1
+    assert faults["replays"]["succeeded"] >= 1
+    assert pool.arena_generation > gen0        # failure reset the arena
+    assert store.stats()["assembly_bytes_peak"] == 0
+    drain(eng)
+    pool.check_invariants()
+    # the store flushed its stale pages and the replayed row released
+    # its own: nothing stays live
+    assert store.acquire_pages(shared) is None
+    assert pool.stats()["pages_live"] == 0, pool.stats()
+    # the store serves again against the fresh arena, bitwise
+    assert store.route(row) == 32
+    out2 = eng.generate(np.asarray(row[m:], np.int32),
+                        max_new_tokens=12,
+                        prefix=np.asarray(row[:m], np.int32))
+    np.testing.assert_array_equal(out2, solo)
+    drain(eng)
+    pool.check_invariants()
+
+
+@pytest.mark.slow  # bundle build + boot (~25 s); the engine/store logic
+# is covered non-slow above — this is the kv_paged wiring proof
+def test_handler_wires_kv_paged(tmp_path):
+    """End-to-end through the generate handler: ``kv_paged`` builds the
+    pool, the continuous engine and the prefix store share it (hits via
+    acquire_pages), /metrics exports ``batching.page_pool``, the
+    response is bitwise the unrouted multi-row reference, and
+    ``assembly_bytes_peak`` stays 0."""
+    from lambdipy_tpu.runtime.loader import load_bundle
+
+    from tests.test_runtime import make_model_bundle
+
+    bundle = make_model_bundle(
+        tmp_path, model="llama-tiny",
+        handler="lambdipy_tpu.runtime.handlers:generate_handler",
+        extra={"max_new_tokens": "8", "batch_mode": "continuous",
+               "batch_max": "2", "kv_paged": "1", "prefix_block": "16",
+               "prefix_cache_mb": "8"})
+    r = load_bundle(bundle, warmup=True)
+    assert r.state.meta["kv_paged"] is True
+    assert r.state.meta["prefix_cache"] is True
+    row = list(range(1, 44))
+    ref = r.state.invoke({"tokens": [row, row]})   # unrouted reference
+    first = r.state.invoke({"tokens": row})
+    second = r.state.invoke({"tokens": row})
+    assert first["ok"] and second["ok"]
+    assert first["prefix_cached"] and second["prefix_cached"]
+    assert first["tokens"][0] == ref["tokens"][0]
+    assert second["tokens"] == first["tokens"]
+    st = r.state.stats()
+    pp = st["batching"]["page_pool"]
+    assert pp["pages_total"] > 0 and pp["shares"] > 0, pp
+    pc = st["prefix_cache"]
+    assert pc["paged"] and pc["hits"] >= 1
+    assert pc["assembly_bytes_peak"] == 0 and pc["assemblies"] == 0
+
+
+def test_admission_reclaims_cold_store_pages(tiny_server):
+    """A cache must never starve admission: when the free list is short
+    the pool's reclaim hook releases the store's cold UNSHARED pages,
+    so the admission that would have shed serves instead — while pages
+    a live acquisition shares survive the reclaim."""
+    cfg = tiny_server.model.cfg
+    page = page_width(cfg.max_len, 16)
+    # room for the store's 2 prefix blocks + 2 pages of slack: an
+    # admission needing 3 pages MUST reclaim store pages to fit
+    pool = PagePool(n_pages=5, page=page,
+                    page_bytes=page_kv_bytes(cfg, page),
+                    make_arena=lambda: init_page_arena(cfg, 5, page))
+    eng = ContinuousBatcher(tiny_server, slots=2, segment=8,
+                            page_pool=pool)
+    store = make_paged_prefix(tiny_server, eng, pool)
+    rowA = list(range(1, 17)) + [99, 98]
+    assert store.route(rowA) == 16          # store holds 1 page
+    rowB = list(range(201, 217)) + [77, 76]
+    assert store.route(rowB) == 16          # store holds 2 pages
+    assert pool.free_count() == 2
+    cold = [5, 4, 3]
+    solo = tiny_server.generate(cold, max_new_tokens=30)
+    # 3 + 30 tokens -> 3 pages: sheds unless a store page reclaims.
+    # Pin A's page first: only B's (colder or not, unshared) may go...
+    held = store.acquire_pages(rowA[:16])
+    assert held is not None
+    out = eng.generate(cold, max_new_tokens=30)
+    np.testing.assert_array_equal(out, solo)
+    pool.check_invariants()
+    st = store.stats()
+    assert st["evictions"] >= 1, st
+    # the PINNED page survived the reclaim (still live and shared);
+    # the unshared one was the victim
+    assert pool.refcount(held[0][0]) >= 2, pool.stats()
+    assert store.acquire_pages(rowB[:16]) is None
+    pool.release(held[0])
+
+
+def test_page_pool_on_metrics_surface(tiny_server):
+    """engine.stats() exports the pool under ``page_pool`` (the
+    ``batching.page_pool`` block on /metrics) with the gauges the issue
+    names: totals, sharing, fragmentation, capacity rows, counters."""
+    eng, pool = mk_paged(tiny_server)
+    eng.generate([1, 2, 3], max_new_tokens=8)
+    st = eng.stats()["page_pool"]
+    for key in ("pages_total", "pages_free", "pages_shared",
+                "internal_fragmentation", "refcount_histogram",
+                "capacity_rows_now", "window_bound_rows", "allocs",
+                "releases", "shares", "sheds", "retry_after_s"):
+        assert key in st, key
+    assert st["pages_total"] == pool.capacity_pages
