@@ -485,10 +485,19 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "prefix block); default sizes it to the same HBM the "
                    "dense engine would allocate: batch_max x window "
                    "pages + the reserved null page")
+@click.option("--spec-k", type=int, default=None,
+              help="speculative decoding inside the continuous engine: "
+                   "each segment drafts up to K-1 tokens per row by "
+                   "prompt lookup and verifies them in ONE multi-token "
+                   "dispatch, emitting 1..K tokens per weight read. "
+                   "Outputs stay bitwise the plain engine's (greedy AND "
+                   "seeded-sampled); acceptance counters ride "
+                   "/metrics under batching.spec. 0/1 disables "
+                   "(default: bundle spec_k, else off)")
 def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
               prefix_block, pipeline_depth, engine_watchdog, kv_paged,
-              kv_pages):
+              kv_pages, spec_k):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
@@ -507,6 +516,8 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_KV_PAGED"] = "1" if kv_paged else "0"
     if kv_pages is not None:
         os.environ["LAMBDIPY_KV_PAGES"] = str(kv_pages)
+    if spec_k is not None:
+        os.environ["LAMBDIPY_SPEC_K"] = str(spec_k)
     # BundleServer resolves the effective policy (bundle extra <
     # LAMBDIPY_SCHED_POLICY env < these flags) and bridges it to the
     # handler's batch formation itself — no env plumbing needed here

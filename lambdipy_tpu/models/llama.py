@@ -334,16 +334,33 @@ class LlamaBlock(nn.Module):
             sp_done = False
             if jnp.ndim(idx) != 0 and cfg.attn_backend == "ring":
                 sp_mesh = _active_sp_mesh()
-                if sp_mesh is not None:
+                if sp_mesh is not None and s == 1:
                     from lambdipy_tpu.parallel.spdecode import (
                         sp_decode_step)
 
-                    assert s == 1, "sp decode requires one-token steps"
                     sp_new = _kv_store(cfg, k, v)
                     sp_cache = {name: cache[name] for name in sp_new}
                     out, new_cache = sp_decode_step(
                         q, sp_new, sp_cache, idx, sp_mesh)
                     sp_done = True
+                elif sp_mesh is not None:
+                    # a multi-token verify chunk under the ring backend:
+                    # sp decode is a one-token-step formulation, so the
+                    # chunk runs the replicated dense path — observable,
+                    # not silent (ROADMAP direction-2 note)
+                    from lambdipy_tpu.parallel.spdecode import (
+                        note_standdown)
+
+                    note_standdown("multi_token_chunk")
+            elif jnp.ndim(idx) != 0 and _active_sp_mesh() is not None:
+                # the mesh HAS an sp axis but the configured backend
+                # (blocked/dense/flash) routes decode around sp_decode:
+                # the cache this step reads is replicated despite the
+                # sharding the operator asked for. Count + log once per
+                # reason so the condition is visible on /metrics.
+                from lambdipy_tpu.parallel.spdecode import note_standdown
+
+                note_standdown(f"attn_backend={cfg.attn_backend}")
             if not sp_done:
                 # quantize this chunk's k/v once under kv_quant; the
                 # cache stays int8 in HBM and the dequant fuses into
@@ -364,17 +381,24 @@ class LlamaBlock(nn.Module):
                              <= (idx + jnp.arange(s))[None, :, None])
                 else:
                     # ragged batch (rows decode from different prompt
-                    # lengths): per-row scatter of this step's single
-                    # position
-                    assert s == 1, \
-                        "per-row cache indices require one-token steps"
+                    # lengths): per-row scatter of this step's (or
+                    # chunk's) positions. s == 1 is the familiar decode
+                    # step; s > 1 is a SPECULATIVE VERIFY CHUNK — row
+                    # r's chunk lands at idx[r]..idx[r]+s-1 and query j
+                    # attends keys <= idx[r]+j (causal within the
+                    # chunk). Out-of-bounds scatter indices DROP (jax
+                    # .at[] default), which is exactly the engine's
+                    # over-decode/rollback contract: a rejected tail or
+                    # past-the-window write lands nowhere a kept token
+                    # can read.
                     rows = jnp.arange(b)
+                    cols = idx[:, None] + jnp.arange(s)[None, :]  # [b, s]
                     for name, val in store.items():
-                        new_cache[name] = cache[name].at[rows, idx].set(
-                            val[:, 0])
+                        new_cache[name] = cache[name].at[
+                            rows[:, None], cols].set(val)
                     t = new_cache[next(iter(store))].shape[1]
                     valid = (jnp.arange(t)[None, None, :]
-                             <= idx[:, None, None])  # [b, 1, t]
+                             <= cols[:, :, None])  # [b, s, t]
                 new_cache = {name: shard_hint(val, "dp", None, "tp")
                              for name, val in new_cache.items()}
                 # length-aware blocked decode attention: one-token steps
@@ -985,6 +1009,64 @@ def _spec_accept_resample(probs, draft, keys):
     return m, new_tok.astype(jnp.int32)
 
 
+def _spec_chain_verify(select, lg, draft, lp_in, keys):
+    """Chain-deterministic draft verification — the continuous engine's
+    accept/rollback core (the batched counterpart of the solo verify
+    fns, specialized to the engine's bitwise contract).
+
+    lg: [b, kb, v] f32 logits of the verify chunk (position i
+    conditioned on the pending token + drafts before i); draft:
+    [b, kb-1] proposals; lp_in: [b] the pending token's logprob carry;
+    keys: [b, 2] the per-row PRNG chains as of the pending token.
+
+    The target here is not a distribution but the CHAIN itself: given a
+    row's seed, ``_scan_decode`` emits a deterministic sequence (greedy
+    rows by argmax, sampled rows by categorical draws along the row's
+    own split-per-step key walk). Verification re-derives that chain's
+    next token at every chunk position — advancing the key walk exactly
+    as the one-token scan would — and accepts the longest draft prefix
+    that MATCHES it. Emitted tokens are therefore bitwise the
+    non-speculative engine's for greedy AND seeded-sampled rows alike
+    (speculation changes how many tokens each weight read verifies,
+    never which tokens) — the property ``bench.py --spec`` gates on.
+    Relative to :func:`_spec_accept_resample`'s rejection sampling (the
+    solo sampled path's distributional contract) the accept test is
+    stricter — token equality instead of probability mass — costing
+    some acceptance on high-entropy sampled rows and buying exact
+    replay/parity. The rejected tail's key splits roll back: the
+    returned chain state is the walk after exactly ``count``
+    selections, so a later segment continues precisely where plain
+    decode would.
+
+    Returns ``(lps_block [b, kb], count [b] in 1..kb, tok' [b],
+    lp' [b], keys' [b, 2])``; ``lps_block[:, 0]`` is the pending
+    token's logprob and column j >= 1 the (j-1)'th selection's — only
+    the first ``count`` columns are meaningful, like the token block."""
+    b, kb, _ = lg.shape
+    tgt, tlp, kstack = [], [], [keys]
+    cur = keys
+    for i in range(kb):
+        cur, subs = _split_rows(cur)
+        t_i, l_i = select(lg[:, i, :], subs)
+        tgt.append(t_i)
+        tlp.append(l_i)
+        kstack.append(cur)
+    tgt = jnp.stack(tgt)          # [kb, b]
+    tlp = jnp.stack(tlp)          # [kb, b]
+    kstack = jnp.stack(kstack)    # [kb + 1, b, 2]
+    ok = (tgt[: kb - 1] == jnp.transpose(draft)).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(ok, axis=0), axis=0)   # [b] 0..kb-1
+    count = m + 1
+    tok2 = jnp.take_along_axis(tgt, m[None, :], axis=0)[0]
+    lp2 = jnp.take_along_axis(tlp, m[None, :], axis=0)[0]
+    keys2 = jnp.take_along_axis(
+        kstack, jnp.broadcast_to(count[None, :, None], (1, b, 2)),
+        axis=0)[0]
+    lps_block = jnp.concatenate(
+        [lp_in[:, None], jnp.transpose(tlp[: kb - 1])], axis=1)
+    return lps_block, count, tok2, lp2, keys2
+
+
 def _lookup_draft(context, k: int, ngram_max: int = 3) -> list:
     """Prompt-lookup drafting (host-side): propose the k tokens that
     followed the most recent earlier occurrence of the context's current
@@ -995,11 +1077,27 @@ def _lookup_draft(context, k: int, ngram_max: int = 3) -> list:
     where speculative decoding pays off — repetitive continuations
     (copying, templated output, and the cycles greedy decodes fall
     into). A wrong draft costs nothing beyond the verify chunk whose
-    weight read was the point of the step anyway."""
+    weight read was the point of the step anyway. An EMPTY context
+    (nothing to look up in) drafts zeros — a draft is only ever a
+    proposal, so a content-free one is safe, just never accepted."""
+    return _lookup_draft_hit(context, k, ngram_max)[0]
+
+
+def _lookup_draft_hit(context, k: int, ngram_max: int = 3) -> tuple:
+    """:func:`_lookup_draft` plus whether an n-gram match was FOUND:
+    ``(draft list of k, hit bool)``. ``hit=False`` marks the fallback
+    (repeat-last-token, or zeros on an empty context) — the engine's
+    per-row draft-miss accounting (``SpecDecodeStats.draft_misses``)
+    keys off it, and ISSUE's "no match falls back to k=1" degeneracy is
+    the observable consequence: a fallback draft usually verifies 0
+    proposals, so the step emits exactly the 1 token plain decode
+    would."""
     import numpy as np
 
     ctx = np.asarray(context, np.int64).reshape(-1)
     n = ctx.size
+    if n == 0:
+        return [0] * k, False
     for g in range(min(ngram_max, n - 1), 0, -1):
         suffix = ctx[n - g:]
         windows = np.lib.stride_tricks.sliding_window_view(ctx, g)[:n - g]
@@ -1009,8 +1107,8 @@ def _lookup_draft(context, k: int, ngram_max: int = 3) -> list:
             cand = ctx[start:start + k]
             out = np.full(k, ctx[-1], np.int64)
             out[:cand.size] = cand
-            return out.tolist()
-    return [int(ctx[-1])] * k
+            return out.tolist(), True
+    return [int(ctx[-1])] * k, False
 
 
 class LlamaServer:
@@ -1044,7 +1142,18 @@ class LlamaServer:
         self._aot = aot
         self._aot_loaded: set = set()
         self.aot_hits = 0  # programs served from the AOT store this boot
+        # Speculative-decoding counters. ``spec_stats`` (the legacy bare
+        # dict — last call's counters, single-threaded convenience only)
+        # is kept for back-compat; the LOCKED, cumulative,
+        # /metrics-surfaced object is ``spec_metrics`` — ONE
+        # SpecDecodeStats instance that both the solo
+        # ``generate_speculative`` path and the continuous engine's
+        # spec mode record into, so acceptance reporting has a single
+        # source of truth under threaded serving.
+        from lambdipy_tpu.runtime.metrics import SpecDecodeStats
+
         self.spec_stats: dict = {}  # last generate_speculative counters
+        self.spec_metrics = SpecDecodeStats()
         # chunked prefill: prompts longer than this prefill through
         # fixed-width chunks against the growing KV cache instead of one
         # wide program. Memory for dense attention drops from O(s^2) to
@@ -1801,6 +1910,69 @@ class LlamaServer:
         return self._fn_cached(("seg_w", b, cache_len, window, segment),
                                build)
 
+    def _spec_seg_fn(self, b: int, cache_len: int, window: int, kb: int):
+        """B-slot SPECULATIVE verify segment for the continuous engine:
+        one multi-token forward scores each row's pending token plus its
+        kb-1 host-drafted proposals through the existing window-bucketed
+        segment math (slice the first ``window`` positions, run, merge
+        back — :meth:`_windowed_seg_fn`'s shape), then
+        :func:`_spec_chain_verify` accepts per row the longest draft
+        prefix matching the row's deterministic chain and rolls the
+        PRNG walk back past the rejected tail. The carry advances by a
+        VARIABLE per-row ``count`` (1..kb): the cache index moves to
+        ``pos + count``, so rejected-tail K/V writes sit beyond the
+        index in already-garbage positions — unreachable behind the
+        validity mask, overwritten by the next chunk before any query
+        could expose them (the same rollback-by-index trick the solo
+        verify fns use, batched). Same 6-leaf carry as the plain
+        segment programs, so the pack/joiner machinery is untouched.
+        Keyed ("spec_seg", ...) in the LRU cache; deliberately not
+        AOT-able, like every load-dependent window variant."""
+        def build():
+            def seg(params, temperature, top_k, top_p, draft, tok, lp,
+                    cache, pos, done, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                win = cache
+                if window < cache_len:
+                    win = [{name: (val if name == "index"
+                                   else jax.lax.slice_in_dim(
+                                       val, 0, window, axis=1))
+                            for name, val in entry.items()}
+                           for entry in cache]
+                # embed a CLAMPED copy of the drafts (an out-of-vocab
+                # proposal would gather a NaN fill row, and 0 * NaN
+                # through the masked attention poisons every row's
+                # output) while verifying against the RAW values — a
+                # clamped alias can therefore never be falsely accepted
+                chunk = jnp.concatenate(
+                    [tok[:, None],
+                     jnp.clip(draft, 0, self.model.cfg.vocab_size - 1)],
+                    axis=1)
+                positions = pos[:, None] + jnp.arange(kb)[None, :]
+                logits, new_cache = self.model.apply(
+                    params, chunk, positions=positions, cache=win)
+                lg = logits.astype(jnp.float32)        # [b, kb, v]
+                lps_block, count, tok2, lp2, keys2 = _spec_chain_verify(
+                    select, lg, draft, lp, rng)
+                pos2 = pos + count
+                for entry in new_cache:
+                    entry["index"] = pos2
+                merged = new_cache
+                if window < cache_len:
+                    merged = [
+                        {name: (val if name == "index"
+                                else jax.lax.dynamic_update_slice_in_dim(
+                                    cache[i][name], val, 0, axis=1))
+                         for name, val in entry.items()}
+                        for i, entry in enumerate(new_cache)]
+                return ((chunk, lps_block, count, tok2),
+                        (tok2, lp2, merged, pos2, done, keys2))
+
+            return jax.jit(seg)
+
+        return self._fn_cached(("spec_seg", b, cache_len, window, kb),
+                               build)
+
     # -- paged KV programs (runtime/pagepool.py arena) ------------------------
     #
     # The paged engine's device programs. Each one follows the same
@@ -1838,6 +2010,47 @@ class LlamaServer:
 
         return self._fn_cached(("pseg", b, n_pages, page, window, segment),
                                build)
+
+    def _spec_pseg_fn(self, b: int, n_pages: int, page: int, window: int,
+                      kb: int):
+        """Paged twin of :meth:`_spec_seg_fn`: gather each row's first
+        ``window`` positions through its block table, run the same
+        verify-chunk math, scatter the written window back. The
+        rollback story composes with paging for free: rejected-tail
+        writes inside the window land in the row's OWN pages at
+        positions beyond its index (overwritten by the next chunk), and
+        writes past the row's allocated pages scatter through
+        null-padded table entries into the reserved null page — page 0
+        absorbs them exactly as it absorbs the dense engine's
+        over-decode, so no transient page charge is needed for the
+        worst-case k-token advance."""
+        def build():
+            def seg(params, temperature, top_k, top_p, draft, tok, lp,
+                    arena, tables, pos, done, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                cache = _gather_page_cache(arena, tables, window, page,
+                                           pos)
+                # clamp-for-embedding / compare-raw, as in _spec_seg_fn
+                chunk = jnp.concatenate(
+                    [tok[:, None],
+                     jnp.clip(draft, 0, self.model.cfg.vocab_size - 1)],
+                    axis=1)
+                positions = pos[:, None] + jnp.arange(kb)[None, :]
+                logits, new_cache = self.model.apply(
+                    params, chunk, positions=positions, cache=cache)
+                lg = logits.astype(jnp.float32)        # [b, kb, v]
+                lps_block, count, tok2, lp2, keys2 = _spec_chain_verify(
+                    select, lg, draft, lp, rng)
+                pos2 = pos + count
+                new_arena = _scatter_page_cache(arena, tables, new_cache,
+                                                page)
+                return ((chunk, lps_block, count, tok2),
+                        (tok2, lp2, new_arena, pos2, done, keys2))
+
+            return jax.jit(seg)
+
+        return self._fn_cached(
+            ("spec_pseg", b, n_pages, page, window, kb), build)
 
     def _paged_pack_fn(self, gb: int, n_pages: int, page: int, width: int):
         """Pack row ``src`` of a ``gb``-row contiguous prefill carry into
@@ -2202,8 +2415,8 @@ class LlamaServer:
         generated: list[int] = []
         steps = 0
         while emitted < max_new_tokens:
-            draft = _lookup_draft(context + [pending], kb,
-                                  ngram_max=ngram_max)
+            draft, draft_hit = _lookup_draft_hit(context + [pending], kb,
+                                                 ngram_max=ngram_max)
             draft_op = jnp.asarray([draft], jnp.int32)
             with self._mesh_ctx():
                 if sampled:
@@ -2230,6 +2443,13 @@ class LlamaServer:
                 {"steps": steps, "emitted": emitted,
                  "tokens_per_step": round(emitted / max(1, steps), 2),
                  "k": kb})
+            # the cumulative /metrics surface (shared with the engine's
+            # spec mode): proposals = the kb-1 drafts, accepted = the
+            # cnt-1 that matched, emitted = accepted + the corrected
+            # token the step owes regardless
+            self.spec_metrics.record_step(
+                proposed=kb - 1, accepted=cnt - 1, emitted=cnt,
+                hit=draft_hit)
             yield toks_step, lps_step
             if eos_id is not None and eos_id in toks_step:
                 return
@@ -2278,6 +2498,7 @@ class LlamaServer:
             stats.update({"fallback": "plain", "steps": max_new_tokens,
                           "emitted": max_new_tokens,
                           "tokens_per_step": 1.0, "k": kb})
+            self.spec_metrics.record_fallback("near_window")
             yield from self.generate_stream(
                 rows[0], max_new_tokens=max_new_tokens, eos_id=eos_id,
                 prefix=prefix, temperature=temperature, top_k=top_k,
@@ -2409,6 +2630,7 @@ class LlamaServer:
                      "emitted": max_new_tokens, "tokens_per_step": 1.0,
                      "k": kb}
             self.spec_stats = stats
+            self.spec_metrics.record_fallback("near_window")
             return (out, stats) if return_stats else out
         emitted: list[int] = []
         lps: list[float] = []

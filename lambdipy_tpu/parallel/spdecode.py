@@ -31,6 +31,7 @@ by the caller per shard-local block before entry.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 
 import jax
@@ -38,8 +39,63 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from lambdipy_tpu.parallel.mesh import shard_map_compat
+from lambdipy_tpu.utils.logs import get_logger
+
+log = get_logger("lambdipy.spdecode")
 
 NEG_INF = -1e30
+
+# -- stand-down observability (ROADMAP direction-2 note) ---------------------
+#
+# sp decode only engages for one-token steps under attn_backend="ring".
+# Configurations that LOOK like the long-context shape (an ambient mesh
+# with sp > 1) but route a decode step elsewhere — blocked/dense
+# attention backends, or a multi-token speculative verify chunk — used
+# to stand down SILENTLY: the operator saw a working server whose
+# decode quietly replicated the KV cache it paid an sp mesh to shard.
+# Every stand-down now bumps the ``spec_standdown`` counter (mirrored
+# into SpecDecodeStats.report / ``/metrics``) and the FIRST occurrence
+# per distinct reason emits one structured log line. Counts accumulate
+# at trace time (one per compiled layer, not per step) — the point is
+# "this condition exists and here is why", not a step-rate gauge.
+
+_standdown_lock = threading.Lock()
+_standdown: dict[str, int] = {}
+_standdown_logged: set = set()
+
+
+def note_standdown(reason: str) -> None:
+    """Record one sp-decode stand-down (mesh had an sp axis, the decode
+    step did not take the sequence-parallel path)."""
+    with _standdown_lock:
+        _standdown[reason] = _standdown.get(reason, 0) + 1
+        first = reason not in _standdown_logged
+        _standdown_logged.add(reason)
+        total = sum(_standdown.values())
+    if first:
+        log.warning(
+            "sp_decode_standdown reason=%s spec_standdown=%d "
+            "(sequence-parallel decode stood down; the KV cache decodes "
+            "replicated despite the mesh's sp axis)", reason, total)
+
+
+def standdown_count() -> int:
+    """Total sp-decode stand-downs recorded this process."""
+    with _standdown_lock:
+        return sum(_standdown.values())
+
+
+def standdown_stats() -> dict:
+    """``spec_standdown`` counter + per-reason breakdown."""
+    with _standdown_lock:
+        return {"spec_standdown": sum(_standdown.values()),
+                "reasons": dict(_standdown)}
+
+
+def _reset_standdowns_for_tests() -> None:
+    with _standdown_lock:
+        _standdown.clear()
+        _standdown_logged.clear()
 
 
 def _owner_write(leaf, new_row, my, t_loc, index):

@@ -77,6 +77,32 @@ Design (all device work rides LlamaServer's compiled-program cache):
   ``x-deadline-ms`` expired are CANCELLED at the next drain barrier
   instead of decoding to completion.
 
+- SPECULATIVE DECODING (``spec_k``, default off): each segment becomes
+  draft -> batched-verify -> accept/rollback. The host drafts up to
+  ``spec_k - 1`` tokens per row by prompt lookup (llama._lookup_draft;
+  rows with no n-gram match fall back to repeat-last drafts whose
+  rejection makes the step emit exactly 1 token — today's path), ONE
+  multi-token verify program scores every row's proposals per dispatch
+  (llama._spec_seg_fn, paged twin _spec_pseg_fn), and the collector
+  books each row's accepted prefix — the rejected tail is discarded
+  exactly like its over-decode discard, its KV already stranded in
+  garbage positions behind the device-side index (dense) or absorbed
+  by the null page (paged). Acceptance is CHAIN-deterministic
+  (llama._spec_chain_verify): a draft is accepted iff it equals the
+  token the row's seeded select chain would emit, so outputs are
+  BITWISE the non-speculative engine's — greedy and seeded-sampled
+  alike — and replay after an engine failure stays exact. Pipelining
+  composes through dispatch-time draft state: at depth >= 2 the host
+  drafts the next step assuming the in-flight one accepts everything
+  (the only regime where speculation pays anyway) and the collector
+  reconciles against fetched truth, resetting the optimistic chain on
+  divergence. Variable per-row advancement is bounded host-side: disp
+  books the worst-case k advance per dispatch and the collector
+  refunds rejected tails, so window bucketing (sized by post-accept
+  max position upper bounds), joiner drains, and quota checks stay
+  exact. Acceptance counters ride ``batching.spec`` on ``/metrics``
+  (runtime/metrics.SpecDecodeStats, shared with the solo spec path).
+
 Opt-in per bundle: ``[payload.extra] batch_mode = "continuous"``
 (default keeps the window MicroBatcher when ``batch_window_ms`` is set).
 """
@@ -127,12 +153,14 @@ class ContinuousBatcher:
                  faults: FaultPlan | None = None,
                  degrade_window_s: float = 60.0,
                  degrade_clean_s: float = 30.0,
-                 page_pool: Any = None):
+                 page_pool: Any = None,
+                 spec_k: int = 0, spec_ngram: int = 3):
         import jax
 
         from lambdipy_tpu.runtime.metrics import (DecodeWindowStats,
                                                   EngineFaultStats,
-                                                  PipelineStats)
+                                                  PipelineStats,
+                                                  SpecDecodeStats)
 
         self.server = server
         cfg = server.model.cfg
@@ -153,6 +181,32 @@ class ContinuousBatcher:
         # host window), >= 2 overlaps device compute with the collector
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.pipeline_stats = PipelineStats(depth=self.pipeline_depth)
+        # -- speculative decoding (default OFF) ------------------------------
+        # spec_k >= 2 turns every engine segment into draft -> batched
+        # multi-token verify -> accept/rollback: the host drafts up to
+        # kb - 1 tokens per row via prompt lookup, ONE kb-wide device
+        # dispatch (models/llama.py _spec_seg_fn / _spec_pseg_fn) scores
+        # all rows' proposals, and the collector keeps each row's
+        # accepted prefix — rolling back the rejected tail exactly like
+        # its over-decode discard. Acceptance is CHAIN-deterministic
+        # (_spec_chain_verify): emitted tokens are bitwise the
+        # non-speculative engine's for greedy and seeded-sampled rows
+        # alike, so spec only changes tokens-per-weight-read.
+        # spec_k <= 1 is plain decode (k = 1 IS today's exact path);
+        # k bucketizes to a pow-2 like the solo path so program count
+        # stays bounded.
+        self.spec_k = 0
+        if spec_k and int(spec_k) >= 2:
+            from lambdipy_tpu.models.llama import _next_bucket
+
+            self.spec_k = max(2, _next_bucket(int(spec_k), 2))
+        self.spec_ngram = max(1, int(spec_ngram))
+        # ONE SpecDecodeStats serves the solo spec path and this engine
+        # (the server owns it); a server without one (stub adapters in
+        # tests) gets a private instance
+        self.spec_metrics = getattr(server, "spec_metrics", None)
+        if self.spec_metrics is None:
+            self.spec_metrics = SpecDecodeStats()
         # bench-only transport model (bench.py --pipeline): each collect
         # pays this extra RTT after device compute completes, like a
         # remote-tunnel device_get, WITHOUT stalling other queued
@@ -593,6 +647,44 @@ class ContinuousBatcher:
                                          self.cache_len, self.segment)
         return seg
 
+    def _spec_draft(self, entry: dict, kb: int, q: int | None = None):
+        """Host-side prompt-lookup draft for ONE verify step of a live
+        row. The draft always EXTRAPOLATES FROM FETCHED TRUTH: the
+        confirmed context (prompt — cached prefix included, a shared
+        system prompt is prime n-gram material — plus booked tokens and
+        the last fetched pending token), extended by lookup itself
+        across the ``q`` still-in-flight verify steps, each assumed to
+        advance its full kb tokens. That accept-all assumption is the
+        pipelined-drafting trick ("dispatch-time draft state"): at
+        depth >= 2 the host drafts step N+1 before step N's results
+        land, and on the repetitive workloads where speculation pays
+        the extrapolation is exactly what the device will emit, so the
+        chain stays hot across the pipeline. When it breaks, the
+        drafts merely miss (every step still emits >= 1 exact chain
+        token — the verify compares against the device's own carry,
+        never this guess) and the very next dispatch re-extrapolates
+        from newer truth. Returns ``(d_verify [kb-1], hit)``."""
+        from lambdipy_tpu.models.llama import _lookup_draft_hit
+
+        base = ((entry.get("prefix_toks") or []) + entry["row"]
+                + entry["toks"])
+        if q is None:
+            q = entry["spec_inflight"]
+        pend = entry.get("spec_pend")
+        if pend is not None:
+            # ext[i] predicts chain position len(base) + 1 + i; the new
+            # step's chunk starts q*kb positions past the pending
+            ext, hit = _lookup_draft_hit(base + [pend],
+                                         (q + 1) * kb - 1,
+                                         ngram_max=self.spec_ngram)
+            return ext[q * kb: q * kb + kb - 1], hit
+        # the device holds the true pending token but the host has not
+        # fetched one yet (freshly packed row): extrapolate from the
+        # prompt alone — ext[0] guesses the pending itself
+        ext, hit = _lookup_draft_hit(base, (q + 1) * kb,
+                                     ngram_max=self.spec_ngram)
+        return ext[q * kb + 1: (q + 1) * kb], hit
+
     # -- fault isolation -----------------------------------------------------
 
     @property
@@ -850,6 +942,13 @@ class ContinuousBatcher:
                     entry["slot"] = None
                     entry["packed"] = False
                     entry["carry"] = None  # re-prefills in the engine
+                    # replayed rows re-draft from scratch; parity holds
+                    # because acceptance is chain-deterministic — the
+                    # replay re-derives the same per-row PRNG walk, so
+                    # the emitted tokens are bitwise the first attempt
+                    # whatever the new drafts propose
+                    entry["spec_pend"] = None
+                    entry["spec_inflight"] = 0
                     if self.pool is not None \
                             and entry.get("prefix_toks"):
                         # the arena reset below zeroes the shared pages
@@ -968,15 +1067,28 @@ class ContinuousBatcher:
             # one host fetch per segment: on a remote-tunnel transport
             # every device_get of a fresh result pays one RTT (~66 ms
             # measured), so the logprob block rides the same fetch — and
-            # only when some active request actually asked for it
-            def fetch():
-                if rec["need_lp"]:
-                    return tuple(map(np.asarray,
-                                     jax.device_get((rec["toks"],
-                                                     rec["lps"]))))
-                return np.asarray(jax.device_get(rec["toks"])), None
+            # only when some active request actually asked for it. A
+            # speculative record additionally carries the per-row accept
+            # COUNTS (how much of the block is real) and the new PENDING
+            # token (the next step's draft anchor) on the same fetch.
+            kb_rec = rec.get("spec", 0)
 
-            block, lp_block = self._device_wait("segment_fetch", gen, fetch)
+            def fetch():
+                want = [rec["toks"]]
+                if rec["need_lp"]:
+                    want.append(rec["lps"])
+                if kb_rec:
+                    want += [rec["counts"], rec["pending"]]
+                got = [np.asarray(x)
+                       for x in jax.device_get(tuple(want))]
+                blk = got.pop(0)
+                lp = got.pop(0) if rec["need_lp"] else None
+                cnt = got.pop(0) if kb_rec else None
+                pend = got.pop(0) if kb_rec else None
+                return blk, lp, cnt, pend
+
+            block, lp_block, counts_h, pending_h = self._device_wait(
+                "segment_fetch", gen, fetch)
             t_end = time.monotonic()
             if self._had_failure:
                 # first successful fetch after a failure: the engine is
@@ -999,25 +1111,60 @@ class ContinuousBatcher:
                     raise _StaleEngine()
                 self.segments_run += 1
                 for slot, entry in rec["rows"]:
+                    # per-row accepted width: everything for a plain
+                    # segment; counts_h[slot] (1..kb) for a verify step
+                    # — the COLLECTOR-SIDE ROLLBACK: the rejected tail
+                    # is simply never booked, structurally the same
+                    # discard as the over-decode branch below (its KV
+                    # already sits in garbage positions behind the
+                    # device-side index)
+                    c = int(counts_h[slot]) if kb_rec else block.shape[1]
+                    hit = rec["assumed"].pop(slot, None) if kb_rec \
+                        else None
+                    if hit is not None:
+                        # this row's step left the pipeline (the row
+                        # may have finished meanwhile — still count it)
+                        entry["spec_inflight"] -= 1
                     if entry["done"]:
                         # over-decode: this block was dispatched before
                         # the row's finish became host-visible — discard
                         # the tail so output stays bitwise the depth-1
                         # engine's
-                        wasted += len(block[slot])
+                        wasted += c
+                        if kb_rec and pool is not None:
+                            entry["disp"] -= (kb_rec - c)
                         continue
                     self.rows_in_segments += 1
+                    row_toks = (block[slot][:c] if kb_rec
+                                else block[slot]).tolist()
                     base = len(entry["toks"])
-                    entry["toks"].extend(block[slot].tolist())
+                    entry["toks"].extend(row_toks)
                     if lp_block is not None:
-                        entry["lps"].extend(lp_block[slot].tolist())
+                        entry["lps"].extend(
+                            (lp_block[slot][:c] if kb_rec
+                             else lp_block[slot]).tolist())
+                    if kb_rec:
+                        # reconcile the optimistic dispatch accounting:
+                        # disp assumed the full kb advance; the step
+                        # really moved c — later window sizing and the
+                        # dispatch quota see truth again. The fetched
+                        # pending becomes the next draft anchor
+                        # (collects are FIFO, so this is always the
+                        # most advanced truth).
+                        entry["disp"] -= (kb_rec - c)
+                        entry["spec_pend"] = int(pending_h[slot])
+                        self.spec_metrics.record_step(
+                            proposed=kb_rec - 1, accepted=c - 1,
+                            emitted=c, hit=bool(hit))
                     eos, n = entry["eos_id"], entry["n"]
                     if eos is not None and entry["eos_at"] is None \
-                            and eos in block[slot]:
+                            and eos in row_toks:
                         # scan only the newly appended block (the old
                         # `eos in entry["toks"]` rescan was O(n^2) over
                         # a long decode) and record the first-hit index
-                        # so truncation needs no second scan
+                        # so truncation needs no second scan — an eos
+                        # INSIDE an accepted draft block lands here like
+                        # any other token
                         entry["eos_at"] = base + \
                             entry["toks"][base:].index(eos)
                     if entry["eos_at"] is not None \
@@ -1270,6 +1417,19 @@ class ContinuousBatcher:
                     # at a time, the easiest shape to recover
                     eff_depth = (1 if self.fault_stats.degrade_level >= 1
                                  else self.pipeline_depth)
+                    # speculative verify width for THIS dispatch: ladder
+                    # level >= 2 pins the plain full-window program (no
+                    # first-use spec/window-variant compiles while the
+                    # device misbehaves) — plain and spec dispatches
+                    # interleave freely because both advance the same
+                    # carry and emit the same deterministic chain
+                    kb = (self.spec_k
+                          if self.spec_k
+                          and self.fault_stats.degrade_level < 2 else 0)
+                    # optimistic per-dispatch advance: a verify step
+                    # moves a row 1..kb tokens; disp books the maximum
+                    # and the collector refunds the shortfall
+                    adv = kb or self.segment
                     with self._lock:
                         if gen != self._gen:
                             raise _StaleEngine()
@@ -1303,6 +1463,10 @@ class ContinuousBatcher:
                         # real (possibly shared) page, where the dense
                         # engine's private cache rows shrugged it off
                         need_lp = False
+                        d_host = (np.zeros((self.slots, kb - 1), np.int32)
+                                  if kb else None)
+                        assumed: dict = {}
+                        to_draft: list = []
                         for slot, e in live:
                             if e["done"]:
                                 # finished mid-pipeline: still stepped
@@ -1310,7 +1474,7 @@ class ContinuousBatcher:
                                 # window need and fetch wants are dead
                                 if pool is not None:
                                     win_pos.append(e["pos0"] + e["disp"])
-                                    e["disp"] += self.segment
+                                    e["disp"] += adv
                                 continue
                             t_host[slot] = e["temperature"] or 0.0
                             k_host[slot] = e["top_k"] or 0
@@ -1318,10 +1482,31 @@ class ContinuousBatcher:
                                             else e["top_p"])
                             # the DEVICE-side position: tokens already
                             # dispatched, not yet necessarily fetched
+                            # (an UPPER BOUND under speculation — the
+                            # collector refunds rejected tails)
                             positions.append(e["pos0"] + e["disp"])
                             win_pos.append(e["pos0"] + e["disp"])
                             need_lp = need_lp or e["want_lp"]
-                            e["disp"] += self.segment
+                            if kb:
+                                # snapshot the in-flight depth now;
+                                # the O(context) lookup itself runs
+                                # AFTER the lock drops (below) — only
+                                # this engine thread mutates toks/spec
+                                # state, so the post-lock read is safe,
+                                # and a concurrent failure handler's
+                                # reset is caught by the generation
+                                # check at dispatch
+                                to_draft.append(
+                                    (slot, e, e["spec_inflight"]))
+                                e["spec_inflight"] += 1
+                            e["disp"] += adv
+                    # host-side drafting OUTSIDE the lock: the n-gram
+                    # scan is O(context) per row, and admit/stream
+                    # waiters must not queue behind it
+                    for slot, e, q in to_draft:
+                        dv, hit = self._spec_draft(e, kb, q)
+                        d_host[slot] = dv
+                        assumed[slot] = hit
                     # window bucketing: the segment's furthest write
                     # lands at max(pos) + segment - 1, so a pow-2 window
                     # >= max(pos) + segment keeps every live row's
@@ -1336,8 +1521,11 @@ class ContinuousBatcher:
                             and self.fault_stats.degrade_level < 2:
                         # ladder level >= 2 pins the full-window program
                         # (no first-use window-variant compiles while
-                        # the device is misbehaving)
-                        needed = max(wpos) + self.segment
+                        # the device is misbehaving). Under speculation
+                        # the positions are POST-ACCEPT upper bounds, so
+                        # the bucket covers the chunk's furthest write
+                        # whatever the rows accept.
+                        needed = max(wpos) + adv
                         window = min(_next_bucket(needed, 16),
                                      self.cache_len)
                     if pool is not None:
@@ -1345,11 +1533,17 @@ class ContinuousBatcher:
                         # window up to one page keeps the gather width a
                         # whole number of table entries
                         window = max(window, pool.page)
-                        seg = server._paged_seg_fn(
-                            self.slots, pool.n_pages, pool.page, window,
-                            self.segment)
+                        seg = (server._spec_pseg_fn(
+                                   self.slots, pool.n_pages, pool.page,
+                                   window, kb) if kb
+                               else server._paged_seg_fn(
+                                   self.slots, pool.n_pages, pool.page,
+                                   window, self.segment))
                         tbl_op = jnp.asarray(
                             tbl_host[:, :window // pool.page])
+                    elif kb:
+                        seg = server._spec_seg_fn(
+                            self.slots, self.cache_len, window, kb)
                     elif window < self.cache_len:
                         seg = server._windowed_seg_fn(
                             self.slots, self.cache_len, window,
@@ -1362,10 +1556,13 @@ class ContinuousBatcher:
                         knob_ops = (jnp.asarray(t_host),
                                     jnp.asarray(k_host),
                                     jnp.asarray(p_host))
+                        draft_ops = ((jnp.asarray(d_host),) if kb
+                                     else ())
                         if pool is None:
                             with server._mesh_ctx():
                                 return seg(server.params, *knob_ops,
-                                           *self._carry, eos_op)
+                                           *draft_ops, *self._carry,
+                                           eos_op)
                         # paged dispatch advances the arena chain: the
                         # lock holds for enqueue time only (dispatch is
                         # async), but the next arena reader must see
@@ -1375,28 +1572,38 @@ class ContinuousBatcher:
                             with server._mesh_ctx():
                                 out, (f2, lp2, new_arena, pos2, done2,
                                       rng2) = seg(
-                                    server.params, *knob_ops, tok_c,
+                                    server.params, *knob_ops,
+                                    *draft_ops, tok_c,
                                     lp_c, pool.arena, tbl_op, pos_c,
                                     done_c, keys_c, eos_op)
                             pool.arena = new_arena
                         return out, (f2, lp2, pos2, done2, rng2)
 
-                    (toks, lps), self._carry = self._device_wait(
+                    outs, self._carry = self._device_wait(
                         "segment_dispatch", gen, dispatch)
+                    if kb:
+                        toks, lps, counts_op, pending_op = outs
+                    else:
+                        toks, lps = outs
                     # attended = per-row sum of positions each step's
                     # attention actually covered (pos + 1 keys at write
-                    # index pos)
-                    inflight.append({
+                    # index pos); a verify chunk computes all kb
+                    # positions whatever it accepts, so adv is the
+                    # honest width either way
+                    rec = {
                         "toks": toks, "lps": lps, "need_lp": need_lp,
                         "rows": live, "window": window,
                         "t_dispatch": t_disp,
-                        "attended": sum(self.segment * p + self.segment
-                                        * (self.segment + 1) // 2
+                        "attended": sum(adv * p + adv * (adv + 1) // 2
                                         for p in positions),
-                        "window_read": (len(positions) * self.segment
-                                        * window),
-                        "full_window": (len(positions) * self.segment
-                                        * self.cache_len)})
+                        "window_read": (len(positions) * adv * window),
+                        "full_window": (len(positions) * adv
+                                        * self.cache_len)}
+                    if kb:
+                        rec.update({"spec": kb, "counts": counts_op,
+                                    "pending": pending_op,
+                                    "assumed": assumed})
+                    inflight.append(rec)
                     pstats.record_dispatch(len(inflight))
                     if len(inflight) >= eff_depth:
                         collect_one()
@@ -1477,6 +1684,12 @@ class ContinuousBatcher:
                  # prompt row/prefix persist so a replayed entry can
                  # re-prefill from its admitted state
                  "replays": 0, "streamed": False, "abandoned": False,
+                 # speculative draft state: the last FETCHED pending
+                 # token (None = the device knows it, the host has not
+                 # collected one yet) and the count of
+                 # dispatched-uncollected verify steps the next draft
+                 # must extrapolate across
+                 "spec_pend": None, "spec_inflight": 0,
                  "row": row, "s": s, "prefix_toks": None,
                  "deadline_at": (time.monotonic() + deadline_ms / 1e3
                                  if deadline_ms else None),
@@ -1736,6 +1949,9 @@ class ContinuousBatcher:
                        if self.faults.active() else {}),
                     "pipeline": self.pipeline_stats.report(),
                     "decode_window": self.window_stats.report(),
+                    **({"spec": {"k": self.spec_k,
+                                 **self.spec_metrics.report()}}
+                       if self.spec_k else {}),
                     "segments_run": self.segments_run,
                     "rows_in_segments": self.rows_in_segments,
                     "requests_served": self.requests_served,

@@ -455,6 +455,16 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 _os.environ.get("LAMBDIPY_MAX_REPLAYS", "1"))
             fspec = extra.get("fault_spec",
                               _os.environ.get("LAMBDIPY_FAULT", ""))
+            # engine-level speculative decoding (DEFAULT OFF this
+            # release): spec_k >= 2 turns every engine segment into
+            # draft -> batched verify -> accept/rollback with bitwise
+            # outputs (continuous.py docstring). `spec_k` extra wins
+            # over the LAMBDIPY_SPEC_K env (the `lambdipy serve
+            # --spec-k` bridge), like the knobs above. Distinct from
+            # the per-REQUEST `"speculative": k` field, which still
+            # serves solo through generate_speculative.
+            sk = extra.get("spec_k",
+                           _os.environ.get("LAMBDIPY_SPEC_K", "0"))
             from lambdipy_tpu.runtime.faults import FaultPlan
 
             # paged KV memory (runtime/pagepool.py, DEFAULT OFF): one
@@ -503,7 +513,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 max_replays=int(mr),
                 faults=(FaultPlan.from_spec(str(fspec))
                         if str(fspec).strip() else None),
-                page_pool=page_pool)
+                page_pool=page_pool,
+                spec_k=int(sk or 0))
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 
@@ -1022,6 +1033,11 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 "seconds": preload_state.get("seconds")}
         if batcher is not None:
             out["batching"] = batcher.stats()
+        if getattr(server, "spec_metrics", None) is not None:
+            # the solo `"speculative": k` path's cumulative acceptance
+            # counters (the engine's batching.spec block shares this
+            # same object when spec_k is on — one source of truth)
+            out["spec"] = server.spec_metrics.report()
         if prefix_store is not None:
             # prefix_cache_{hits,misses,hit_tokens,evictions,bytes} +
             # hit_rate — the automatic radix reuse surface

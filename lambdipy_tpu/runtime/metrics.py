@@ -288,6 +288,97 @@ class EngineFaultStats:
 
 
 @dataclass
+class SpecDecodeStats:
+    """Counters for speculative decoding — the ``batching.spec`` block on
+    ``/metrics`` when the continuous engine runs with ``spec_k``, and the
+    ``spec`` block for the solo ``"speculative": k`` request path. ONE
+    object serves both (``LlamaServer.spec_metrics``; the engine shares
+    the server's instance), so operators read acceptance through one
+    surface regardless of which path a request took.
+
+    A *step* is one verify dispatch: ``proposed`` draft tokens offered
+    (``kb - 1`` per step), ``accepted`` of them matched the target
+    chain, ``emitted`` tokens delivered (accepted + the always-correct
+    corrected/pending token). ``acceptance_rate`` = accepted/proposed;
+    ``tokens_per_step`` = emitted/steps — the speedup's direct proxy
+    (decode is weight-bytes-bound, so tokens/step ~ tok/s multiplier).
+    ``wasted_verify_tokens`` are proposed-but-rejected positions: the
+    verify FLOPs burned for nothing (each rejected position still paid
+    its slice of the chunk forward). ``draft_hits``/``draft_misses``
+    split steps by whether prompt-lookup found an n-gram match or fell
+    back (repeat-last-token / unknown pending); ``hist`` buckets steps
+    by tokens emitted (1..kb — a mass at 1 means drafts never land).
+    ``fallback_rows`` counts whole requests that degraded to plain
+    decode (no room for a verify chunk near the context boundary).
+    ``row_fallbacks`` keys those by reason. ``sp_standdown`` mirrors
+    the sequence-parallel decode stand-down counter
+    (:func:`lambdipy_tpu.parallel.spdecode.standdown_count`) so the
+    silently-degraded long-context condition is visible next to the
+    speculation counters it gates."""
+
+    steps: int = 0
+    emitted_tokens: int = 0
+    proposed_tokens: int = 0
+    accepted_tokens: int = 0
+    wasted_verify_tokens: int = 0
+    draft_hits: int = 0
+    draft_misses: int = 0
+    fallback_rows: int = 0
+    row_fallbacks: dict = field(default_factory=dict)  # reason -> rows
+    hist: dict = field(default_factory=dict)           # emitted -> steps
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_step(self, *, proposed: int, accepted: int, emitted: int,
+                    hit: bool) -> None:
+        with self._lock:
+            self.steps += 1
+            self.proposed_tokens += int(proposed)
+            self.accepted_tokens += int(accepted)
+            self.emitted_tokens += int(emitted)
+            self.wasted_verify_tokens += max(0, int(proposed) - int(accepted))
+            if hit:
+                self.draft_hits += 1
+            else:
+                self.draft_misses += 1
+            self.hist[int(emitted)] = self.hist.get(int(emitted), 0) + 1
+
+    def record_fallback(self, reason: str = "plain") -> None:
+        with self._lock:
+            self.fallback_rows += 1
+            self.row_fallbacks[str(reason)] = \
+                self.row_fallbacks.get(str(reason), 0) + 1
+
+    def report(self) -> dict:
+        try:
+            from lambdipy_tpu.parallel.spdecode import standdown_count
+            standdowns = standdown_count()
+        except Exception:  # pragma: no cover — observability only
+            standdowns = 0
+        with self._lock:
+            steps, proposed = self.steps, self.proposed_tokens
+            return {
+                "steps": steps,
+                "emitted_tokens": self.emitted_tokens,
+                "proposed_tokens": proposed,
+                "accepted_tokens": self.accepted_tokens,
+                "acceptance_rate": (round(self.accepted_tokens / proposed, 4)
+                                    if proposed else 0.0),
+                "tokens_per_step": (round(self.emitted_tokens / steps, 3)
+                                    if steps else 0.0),
+                "wasted_verify_tokens": self.wasted_verify_tokens,
+                "draft_hits": self.draft_hits,
+                "draft_misses": self.draft_misses,
+                "draft_hit_rate": (round(self.draft_hits / steps, 4)
+                                   if steps else 0.0),
+                "fallback_rows": self.fallback_rows,
+                "row_fallbacks": dict(self.row_fallbacks),
+                "tokens_per_step_hist": {str(n): c for n, c in
+                                         sorted(self.hist.items())},
+                "sp_standdown": standdowns,
+            }
+
+
+@dataclass
 class PagePoolStats:
     """Counters for the paged KV memory manager (the
     ``batching.page_pool`` block on ``/metrics``; gauges — pages

@@ -191,6 +191,106 @@ def bench_paged_decode_attention(rtt: float):
         print(json.dumps(out))
 
 
+def bench_spec_verify(rtt: float):
+    """The speculative-decoding amortization question, measured at the
+    op level: ONE k-token verify chunk vs k sequential one-token decode
+    steps, at 8B decode shapes. Decode is weight-bytes-bound, so the
+    chunk should cost barely more than a single step (same weight
+    read, k x the MXU work which is nowhere near the roofline at small
+    batch) — ``speedup`` is the per-token gain an accept-all verify
+    step realizes over plain decode, the on-chip ceiling for the
+    engine's ``spec_k`` mode (bench.py --spec measures the CPU-scale
+    end-to-end twin). Two ops cover the two traffic classes:
+
+    - weight matmul (the dominant decode cost): bf16 [m, k] @ [k, n]
+      at the 8B qo/mlp shapes, m = 1 (one step) vs m = k_spec (one
+      chunk); ``seq_ms`` runs k_spec m=1 matmuls serialized in one
+      program, ``chunk_ms`` the single wide one.
+    - decode attention: k_spec sequential 1-token reads of an 8192-
+      position KV window vs one k_spec-query chunk over the same
+      window (the chunk re-reads the window once instead of k times).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lambdipy_tpu.ops.decode_attention import \
+        decode_attention_reference
+
+    rng = np.random.default_rng(0)
+    for k_spec in (4, 8, 16):
+        out = {"op": "spec_verify", "k": k_spec}
+        # weight-read amortization at the big mlp shape
+        kk, n = 4096, 14336
+        w = jnp.asarray(rng.standard_normal((kk, n), np.float32),
+                        jnp.bfloat16)
+        x1 = jnp.asarray(rng.standard_normal((1, kk), np.float32),
+                         jnp.bfloat16)
+        xk = jnp.asarray(rng.standard_normal((k_spec, kk), np.float32),
+                         jnp.bfloat16)
+        iters = 50
+
+        def seq_op(c):
+            def step(x, _):
+                y = x @ w
+                bump = (jnp.abs(y).astype(jnp.float32).sum() * 1e-20
+                        ).astype(x.dtype)
+                return x + bump, ()
+
+            x, _ = jax.lax.scan(step, c, None, length=k_spec)
+            return x
+
+        seq = _scan_many(seq_op, iters)
+        chunk = _scan_many(lambda c: c @ w, iters)
+        out["matmul_seq_ms"] = round(_amortized_ms(
+            lambda: seq(x1), rtt, iters), 4)
+        out["matmul_chunk_ms"] = round(_amortized_ms(
+            lambda: chunk(xk), rtt, iters), 4)
+        out["matmul_speedup"] = round(
+            out["matmul_seq_ms"] / max(out["matmul_chunk_ms"], 1e-4), 2)
+
+        # KV-window amortization: chunk attends once, steps k times
+        b, h, kvh, d, t = 1, 32, 8, 128, 8192
+        key = jax.random.PRNGKey(0)
+        kq, kkey, kv = jax.random.split(key, 3)
+        kc = jax.random.normal(kkey, (b, t, kvh, d), jnp.bfloat16)
+        vc = jax.random.normal(kv, (b, t, kvh, d), jnp.bfloat16)
+        lens = jnp.full((b,), t, jnp.int32)
+        q1 = jax.random.normal(kq, (b, 1, h, d), jnp.bfloat16)
+        qk_ = jax.random.normal(kq, (b, k_spec, h, d), jnp.bfloat16)
+
+        def attn_seq(c):
+            def step(x, _):
+                y = decode_attention_reference(x, kc, vc, lens)
+                bump = (jnp.abs(y).astype(jnp.float32).sum() * 1e-20
+                        ).astype(x.dtype)
+                return x + bump, ()
+
+            x, _ = jax.lax.scan(step, c, None, length=k_spec)
+            return x
+
+        def attn_chunk(c):
+            # the verify chunk's attention: every query reads the same
+            # window once (causal masking differences are noise at
+            # t = 8192)
+            return decode_attention_reference(
+                c.reshape(b * k_spec, 1, h, d),
+                jnp.broadcast_to(kc, (b * k_spec, t, kvh, d)),
+                jnp.broadcast_to(vc, (b * k_spec, t, kvh, d)),
+                jnp.full((b * k_spec,), t, jnp.int32))
+
+        a_iters = 20
+        aseq = _scan_many(attn_seq, a_iters)
+        achunk = _scan_many(attn_chunk, a_iters)
+        out["attn_seq_ms"] = round(_amortized_ms(
+            lambda: aseq(q1), rtt, a_iters), 4)
+        out["attn_chunk_ms"] = round(_amortized_ms(
+            lambda: achunk(qk_), rtt, a_iters), 4)
+        out["attn_speedup"] = round(
+            out["attn_seq_ms"] / max(out["attn_chunk_ms"], 1e-4), 2)
+        print(json.dumps(out))
+
+
 def bench_int8_matmul(rtt: float):
     import jax
     import jax.numpy as jnp
@@ -242,6 +342,7 @@ def main() -> int:
     bench_attention(rtt)
     bench_decode_attention(rtt)
     bench_paged_decode_attention(rtt)
+    bench_spec_verify(rtt)
     bench_int8_matmul(rtt)
     return 0
 

@@ -36,6 +36,12 @@
 # phase 9 the PAGED-KV sweep (bench.py --paged: bitwise paged-vs-dense
 # parity, zero-copy prefix hits, token-bounded capacity margin).
 #
+# Phase 10 is the SPECULATIVE-DECODING sweep (bench.py --spec: bitwise
+# engine parity spec-on-vs-off — greedy + seeded-sampled, cold +
+# prefix-hit, streamed, concurrent rows, pipeline depths 1-2, dense +
+# paged — plus the >1.5x tok/s claim on a repetitive-continuation
+# workload with acceptance counters published under batching.spec).
+#
 # Every phase prints its wall-clock so the budget breakdown is visible
 # in the log (ROADMAP open item: phase 2 runs close to its 870 s cap).
 
@@ -174,4 +180,20 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 9"
+
+# Phase 10: speculative-decoding smoke — bench.py --spec exits nonzero
+# if any spec-on engine output diverges bitwise from the plain path
+# (greedy + seeded-sampled, cold + prefix hits, streamed, concurrent,
+# depths 1-2, dense + paged), if the accept-all workload fails to
+# verify >1 token per weight read, or if engine tok/s fails to beat
+# the plain engine by >1.5x on the repetitive-continuation workload
+# (acceptance rate + tokens/step print in the JSON line and ride
+# /metrics under batching.spec on live servers).
+phase_begin "phase 10: speculative decoding sweep (bench.py --spec)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --spec; then
+    echo "FATAL: bench.py --spec sweep failed" >&2
+    exit 1
+fi
+phase_end "phase 10"
 exit 0

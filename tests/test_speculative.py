@@ -25,6 +25,96 @@ def test_lookup_draft_follows_repeats():
     assert _lookup_draft([4, 9, 9, 4], 3)[0] == 9
 
 
+def test_lookup_draft_edge_cases():
+    """Degenerate inputs the engine's draft loop can hand the lookup:
+    empty context, contexts shorter than ngram_max, and the hit flag
+    distinguishing a real n-gram match from the fallback."""
+    from lambdipy_tpu.models.llama import _lookup_draft_hit
+
+    # empty context: content-free zeros, never a crash (and never a
+    # false hit — zeros are only proposals, the verify rejects them)
+    assert _lookup_draft_hit([], 4) == ([0, 0, 0, 0], False)
+    assert _lookup_draft([], 2) == [0, 0]
+    # single-token context (shorter than any n-gram window): fallback
+    assert _lookup_draft_hit([7], 3) == ([7, 7, 7], False)
+    # two tokens, one repeat: the g=1 window still matches
+    draft, hit = _lookup_draft_hit([9, 9], 3)
+    assert hit and draft[0] == 9
+    # context shorter than ngram_max but with a bigram repeat: matches
+    # at the longest g that fits, not ngram_max; the candidate stops at
+    # the context end and pads with the last token
+    draft, hit = _lookup_draft_hit([5, 6, 5, 6], 4, ngram_max=3)
+    assert hit and draft == [5, 6, 6, 6]
+    # hit flag splits match from fallback
+    assert _lookup_draft_hit([1, 2, 3], 3)[1] is False
+    assert _lookup_draft_hit([1, 5, 6, 7, 8, 9, 2, 5, 6, 7], 3)[1] is True
+
+
+def test_lookup_draft_proposes_eos(tiny_server):
+    """A draft CONTAINING the eos token is proposed like any other (the
+    lookup has no eos concept) and the verify path latches it with
+    fused-path parity."""
+    eos = 42
+    ctx = [1, 5, 6, 7, eos, 9, 2, 5, 6, 7]
+    assert _lookup_draft(ctx, 3) == [eos, 9, 2]
+    # end-to-end: an eos the model actually emits inside an accepted
+    # block truncates + fills exactly like the plain path
+    free = tiny_server.generate([5, 6, 7, 8], max_new_tokens=12)[0]
+    model_eos = int(free[5])
+    ref = tiny_server.generate([5, 6, 7, 8], max_new_tokens=12,
+                               eos_id=model_eos)
+    out = tiny_server.generate_speculative([5, 6, 7, 8],
+                                           max_new_tokens=12, k=8,
+                                           eos_id=model_eos)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_speculative_k1_degenerates_to_plain(tiny_server):
+    """k=1 (no real drafting room — the kb floor is a 2-chunk) must
+    equal plain decode token for token, and the engine knob disables at
+    spec_k <= 1 (k=1 IS the plain path)."""
+    ref = tiny_server.generate([5, 6, 7, 8], max_new_tokens=12)
+    out = tiny_server.generate_speculative([5, 6, 7, 8],
+                                           max_new_tokens=12, k=1)
+    np.testing.assert_array_equal(out, ref)
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    cb = ContinuousBatcher(tiny_server, slots=2, segment=4, spec_k=1)
+    assert cb.spec_k == 0
+    np.testing.assert_array_equal(
+        cb.generate([5, 6, 7, 8], max_new_tokens=12), ref)
+
+
+def test_sp_decode_standdown_is_observable(cpu_devices):
+    """ROADMAP direction-2 note: sp decode silently stood down under
+    blocked attention. The condition now bumps the spec_standdown
+    counter (one structured log line per distinct reason) and surfaces
+    through SpecDecodeStats.report."""
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.parallel import spdecode
+    from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+    from lambdipy_tpu.runtime.metrics import SpecDecodeStats
+
+    spdecode._reset_standdowns_for_tests()
+    assert spdecode.standdown_count() == 0
+    adapter = registry.get("llama-tiny").build(
+        extra={"attn_backend": "blocked"})
+    params = adapter.init_params(seed=0)
+    server = adapter.make_server(params)
+    mesh = make_mesh({"sp": 2}, devices=cpu_devices[:2])
+    server.mesh = mesh
+    with use_mesh(mesh):
+        server.generate([1, 2, 3], max_new_tokens=1)
+    n = spdecode.standdown_count()
+    assert n > 0, "blocked-backend decode under an sp mesh must record"
+    stats = spdecode.standdown_stats()
+    assert stats["spec_standdown"] == n
+    assert any(r.startswith("attn_backend=") for r in stats["reasons"])
+    # mirrored onto the /metrics spec block
+    assert SpecDecodeStats().report()["sp_standdown"] == n
+    spdecode._reset_standdowns_for_tests()
+
+
 def test_speculative_matches_plain_greedy(tiny_server):
     """The core guarantee: speculative output is BITWISE the plain greedy
     output for any k (drafts change the verification batching, never the
