@@ -1314,6 +1314,216 @@ def spec_record(*, n_requests: int = 3, n_new: int = 64, k: int = 8,
     }
 
 
+def mesh_record(*, n_requests: int = 3, n_new: int = 16, segment: int = 4,
+                slots: int = 4, block: int = 32, depths=(1, 2),
+                reps: int = 2, extra: dict | None = None) -> dict:
+    """Tensor-parallel sharded-serving sweep (CPU-runnable over 2 host
+    devices — run it via ``bench.py --mesh``, whose entry point forces
+    ``--xla_force_host_platform_device_count=2`` BEFORE jax first
+    initializes; calling this function from a process whose jax already
+    sees one device raises rather than measuring nothing), gating the
+    two claims the ``mesh`` knob makes:
+
+    1. BITWISE PARITY tp=2 vs tp=1 — greedy AND seeded-sampled, cold
+       rows and prefix-cache hits (cold walk + zero-copy/dense hit),
+       streamed, under concurrent traffic, at pipeline depths 1 and 2,
+       dense AND paged: the sharded engine's tokens equal the
+       single-device server's exactly. The Megatron TP layout shards
+       output channels, so per-output reductions keep their order and
+       the collectives XLA inserts reproduce the unsharded arithmetic.
+    2. PER-DEVICE HBM — the engine's KV residency (B-slot carry dense,
+       page arena paged) and the params each cost <= 0.55x their
+       replicated footprint per device on the tp=2 mesh, read from the
+       LIVE ``batching.mesh`` gauges after serving traffic (so a
+       segment program silently resharding the carry back to
+       replicated would fail the gate, not just the init-time claim).
+
+    tok/s for tp=1 vs tp=2 is REPORTED, not gated: at tiny CPU dims the
+    per-layer collectives dominate and tp=2 is expected slower — the
+    mesh pays off where BENCH_r04 lives (8B at >0.8 single-chip HBM
+    util), and what this sweep pins down is correctness + the HBM
+    split that makes those deployments possible at all."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.models.llama import init_page_arena, page_kv_bytes
+    from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+    from lambdipy_tpu.parallel.sharding import shard_params
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+    from lambdipy_tpu.runtime.pagepool import PagePool, page_width
+    from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+    if len(jax.devices()) < 2:
+        raise AssertionError(
+            "mesh sweep needs >= 2 devices (run via bench.py --mesh, "
+            "which forces 2 host devices)")
+
+    dims = {"vocab_size": 2048, "hidden": 128, "layers": 2, "heads": 4,
+            "kv_heads": 2, "mlp": 256, "max_len": 256}
+    dims.update(extra or {})
+    adapter = registry.get("llama3-8b").build(dtype="float32", extra=dims)
+    cfg = adapter.config
+    host_params = adapter.init_params(seed=0)
+    ref_server = adapter.make_server(jax.device_put(host_params),
+                                     prefix_cache_max=2)
+
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(1, cfg.vocab_size, 4 + i).tolist()
+            for i in range(n_requests)]
+    sample_kw = dict(temperature=0.8, top_k=32, seed=11)
+    refs = {tuple(p): ref_server.generate(p, max_new_tokens=n_new)
+            for p in rows}
+    refs_s = {tuple(p): ref_server.generate(p, max_new_tokens=n_new,
+                                            **sample_kw) for p in rows}
+    shared = rng.integers(1, cfg.vocab_size, 2 * block).tolist()
+    pfx_rows = [shared + rng.integers(1, cfg.vocab_size, 4).tolist()
+                for _ in range(2)]
+    for r in pfx_rows:
+        refs[tuple(r)] = ref_server.generate(r, max_new_tokens=n_new)
+
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    with use_mesh(mesh):
+        tp_params = shard_params(host_params, mesh, adapter.tp_rules)
+    tp_server = adapter.make_server(tp_params, mesh=mesh,
+                                    prefix_cache_max=2)
+    page = page_width(cfg.max_len, block)
+
+    def mk_engine(server, depth: int, paged: bool, srv_mesh):
+        pool = None
+        if paged:
+            n_pages = slots * (cfg.max_len // page) + 1
+            pool = PagePool(
+                n_pages=n_pages, page=page,
+                page_bytes=page_kv_bytes(cfg, page),
+                make_arena=lambda n=n_pages, m=srv_mesh: init_page_arena(
+                    cfg, n, page, mesh=m))
+        eng = ContinuousBatcher(server, slots=slots, segment=segment,
+                                pipeline_depth=depth, page_pool=pool)
+        store = PrefixStore(server, block=block, budget_mb=64, pool=pool)
+        if pool is not None:
+            eng.prefix_pages_fn = store.acquire_pages
+        return eng, store
+
+    def routed(eng, store, row, sampled=False, stream=False):
+        m = store.route(row)
+        kw = dict(sample_kw) if sampled else {}
+        pfx = np.asarray(row[:m], np.int32) if m > 0 else None
+        suf = np.asarray(row[m:], np.int32) if m > 0 else row
+        if stream:
+            return np.concatenate(
+                list(eng.generate_stream(suf, max_new_tokens=n_new,
+                                         prefix=pfx, **kw)),
+                axis=1)[:, :n_new]
+        return eng.generate(suf, max_new_tokens=n_new, prefix=pfx, **kw)
+
+    parity_checked = 0
+    mesh_blocks = {}
+    for paged in (False, True):
+        for depth in sorted(set(depths)):
+            eng, store = mk_engine(tp_server, depth, paged, mesh)
+            # concurrent cold greedy rows
+            with ThreadPoolExecutor(max_workers=len(rows)) as ex:
+                outs = list(ex.map(
+                    lambda r: eng.generate(r, max_new_tokens=n_new),
+                    rows))
+            for r, o in zip(rows, outs):
+                assert np.array_equal(o, refs[tuple(r)]), (
+                    f"tp=2 depth={depth} paged={paged}: cold greedy "
+                    "parity broke")
+                parity_checked += 1
+            # seeded-sampled rows
+            for r in rows[:2]:
+                o = eng.generate(r, max_new_tokens=n_new, **sample_kw)
+                assert np.array_equal(o, refs_s[tuple(r)]), (
+                    f"tp=2 depth={depth} paged={paged}: sampled parity "
+                    "broke")
+                parity_checked += 1
+            # prefix rows: cold walk, then the (zero-copy / dense) hit
+            for r in pfx_rows:
+                o = routed(eng, store, r)
+                assert np.array_equal(o, refs[tuple(r)]), (
+                    f"tp=2 depth={depth} paged={paged}: prefix parity "
+                    "broke")
+                parity_checked += 1
+            # streamed hit: concatenated chunks == fused output
+            o = routed(eng, store, pfx_rows[0], stream=True)
+            assert np.array_equal(o, refs[tuple(pfx_rows[0])]), (
+                f"tp=2 depth={depth} paged={paged}: streamed parity "
+                "broke")
+            parity_checked += 1
+            with eng._lock:
+                while eng._engine_running:
+                    eng._lock.wait(0.05)
+            stats = eng.stats()
+            mb = stats.get("mesh")
+            assert mb is not None and mb["segments_sharded"] > 0, stats
+            # the HBM gate: live per-device KV <= 0.55x replicated
+            assert mb["kv_bytes_per_device"] <= \
+                0.55 * mb["kv_bytes_replicated"], (
+                    f"per-device KV bytes not halved (paged={paged}): "
+                    f"{mb}")
+            assert mb["param_bytes_per_device"] <= \
+                0.55 * mb["param_bytes_total"], mb
+            mesh_blocks["paged" if paged else "dense"] = mb
+            if paged:
+                eng.pool.check_invariants()
+
+    # -- throughput: tp=1 vs tp=2, reported ---------------------------------
+    def timed(server):
+        eng = ContinuousBatcher(server, slots=slots, segment=segment,
+                                pipeline_depth=1)
+        work = [list(rows[i % len(rows)]) for i in range(slots)]
+        with ThreadPoolExecutor(max_workers=slots) as ex:  # warm
+            list(ex.map(lambda r: eng.generate(r, max_new_tokens=n_new),
+                        work))
+        walls = []
+        for _ in range(max(1, reps)):
+            t0 = time.monotonic()
+            with ThreadPoolExecutor(max_workers=slots) as ex:
+                outs = list(ex.map(
+                    lambda r: eng.generate(r, max_new_tokens=n_new),
+                    work))
+            walls.append(time.monotonic() - t0)
+            for r, o in zip(work, outs):
+                assert np.array_equal(o, refs[tuple(r)]), \
+                    "throughput-leg parity broke"
+        with eng._lock:
+            while eng._engine_running:
+                eng._lock.wait(0.05)
+        return slots * n_new / min(walls)
+
+    tok_s_tp1 = timed(ref_server)
+    tok_s_tp2 = timed(tp_server)
+
+    return {
+        "mode": "mesh",
+        "platform": jax.devices()[0].platform,
+        "devices": len(jax.devices()),
+        "mesh": {"tp": 2},
+        "n_requests": len(rows),
+        "n_new": n_new,
+        "segment": segment,
+        "parity_rows_checked": parity_checked,
+        "parity": True,
+        "kv_bytes_per_device_dense": mesh_blocks["dense"][
+            "kv_bytes_per_device"],
+        "kv_bytes_replicated_dense": mesh_blocks["dense"][
+            "kv_bytes_replicated"],
+        "hbm_savings_dense": mesh_blocks["dense"]["hbm_savings"],
+        "hbm_savings_paged": mesh_blocks["paged"]["hbm_savings"],
+        "param_savings": mesh_blocks["dense"]["param_savings"],
+        "collectives_per_segment": mesh_blocks["dense"][
+            "collectives_per_segment"],
+        "engine_tok_s_tp1": round(tok_s_tp1, 1),
+        "engine_tok_s_tp2": round(tok_s_tp2, 1),
+        "tp2_speedup_cpu": round(tok_s_tp2 / tok_s_tp1, 3),
+    }
+
+
 def chaos_record(*, kinds=("exception", "delay", "hang"),
                  n_new: int = 16, segment: int = 4,
                  watchdog_s: float = 1.0, max_replays: int = 1,
@@ -1825,6 +2035,37 @@ def _spec_main() -> int:
     return 0
 
 
+def _mesh_main() -> int:
+    import argparse
+
+    # the sweep needs >= 2 devices; on the CPU platform that means
+    # forcing host devices BEFORE jax initializes (this branch runs
+    # before any jax import — bench.py's module top imports none)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mesh", action="store_true")
+    ap.add_argument("--requests", type=int, default=3)
+    ap.add_argument("--n-new", type=int, default=16)
+    ap.add_argument("--segment", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--block", type=int, default=32)
+    ap.add_argument("--depths", type=str, default="1,2")
+    ap.add_argument("--reps", type=int, default=2)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(mesh_record(
+        n_requests=args.requests, n_new=args.n_new, segment=args.segment,
+        slots=args.slots, block=args.block,
+        depths=tuple(int(x) for x in args.depths.split(",")),
+        reps=args.reps)))
+    return 0
+
+
 def _decode_window_main() -> int:
     import argparse
 
@@ -1970,6 +2211,13 @@ def main() -> int:
         # claim on a repetitive-continuation workload, acceptance
         # counters published through batching.spec
         return _spec_main()
+    if "--mesh" in sys.argv:
+        # CPU-runnable tensor-parallel sharded-serving sweep (forces 2
+        # host devices): bitwise tp=2-vs-tp=1 parity — greedy + sampled,
+        # cold + prefix-hit, streamed, concurrent, depths 1-2, dense +
+        # paged — plus the per-device KV/param HBM halving gate read
+        # from the live batching.mesh gauges; tp=1-vs-tp=2 tok/s printed
+        return _mesh_main()
     if "--paged" in sys.argv:
         # CPU-runnable paged-KV sweep: bitwise paged-vs-dense parity
         # (cold/prefix/sampled/streamed, depths 1-2, concurrent), the

@@ -494,10 +494,20 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "seeded-sampled); acceptance counters ride "
                    "/metrics under batching.spec. 0/1 disables "
                    "(default: bundle spec_k, else off)")
+@click.option("--mesh", "mesh_spec", type=str, default=None,
+              help="tensor-parallel sharded serving over a device mesh, "
+                   "e.g. 'tp=2' (Megatron layout: attention heads + MLP "
+                   "hidden sharded over tp, KV cache over kv_heads, "
+                   "per-device HBM ~1/tp). Accepts 'tp=2', bare '2', "
+                   "'2x2' (dp x tp), or 'off'. Outputs stay bitwise the "
+                   "single-device path's; layout + per-device bytes ride "
+                   "/metrics under batching.mesh. CPU testing: "
+                   "XLA_FLAGS=--xla_force_host_platform_device_count=2 "
+                   "(default: bundle mesh extra, else single-device)")
 def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
               prefix_block, pipeline_depth, engine_watchdog, kv_paged,
-              kv_pages, spec_k):
+              kv_pages, spec_k, mesh_spec):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
@@ -518,6 +528,13 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_KV_PAGES"] = str(kv_pages)
     if spec_k is not None:
         os.environ["LAMBDIPY_SPEC_K"] = str(spec_k)
+    if mesh_spec is not None:
+        # validate at the CLI so a typo'd mesh fails HERE with a clear
+        # message instead of inside the bundle boot
+        from lambdipy_tpu.parallel.mesh import parse_mesh_spec
+
+        parse_mesh_spec(mesh_spec)
+        os.environ["LAMBDIPY_MESH"] = mesh_spec
     # BundleServer resolves the effective policy (bundle extra <
     # LAMBDIPY_SCHED_POLICY env < these flags) and bridges it to the
     # handler's batch formation itself — no env plumbing needed here

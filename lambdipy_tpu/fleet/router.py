@@ -776,6 +776,11 @@ class FleetRouter:
         for t in threads:
             t.join(timeout=self.pool.probe_timeout + 2.0)
         agg = {"hits": 0, "misses": 0, "hit_tokens": 0}
+        # fleet-wide sp-decode stand-downs, keyed by reason: a sharded
+        # replica whose decode quietly replicated the KV cache it paid
+        # an sp mesh to shard (or whose spec_k stood down under it) must
+        # be visible AT THE ROUTER, not only on the one replica's page
+        sd_total, sd_reasons = 0, {}
         for name in sorted(self.pool.replicas):
             m = per_replica.setdefault(name, None)
             if m is None:
@@ -784,6 +789,12 @@ class FleetRouter:
             if isinstance(pc, dict):
                 for k in agg:
                     agg[k] += int(pc.get(k, 0))
+            sp = (m.get("handler") or {}).get("spec")
+            if isinstance(sp, dict):
+                sd_total += int(sp.get("sp_standdown", 0) or 0)
+                for reason, n in (sp.get("sp_standdown_reasons")
+                                  or {}).items():
+                    sd_reasons[reason] = sd_reasons.get(reason, 0) + int(n)
         total = agg["hits"] + agg["misses"]
         routable = self.pool.routable()
         router_rep = self.stats.report()
@@ -811,6 +822,8 @@ class FleetRouter:
                     "hit_rate": (round(agg["hits"] / total, 4)
                                  if total else 0.0),
                 },
+                "spec_standdown": {"total": sd_total,
+                                   "reasons": sd_reasons},
             },
             "replicas": per_replica,
         }

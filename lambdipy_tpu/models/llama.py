@@ -512,6 +512,72 @@ def init_decode_cache(cfg: LlamaConfig, batch: int, max_len: int):
             for _ in range(cfg.layers)]
 
 
+# The ONE KV-cache layout rule for tensor-parallel serving: every
+# store-layout leaf is [..., seq, kv_heads, d-or-1], so the kv-head dim
+# (axis 2 for both the [b, t, kvh, *] decode cache and the
+# [n_pages, page, kvh, *] arena) shards over ``tp`` and everything else
+# replicates. Matches the in-program ``shard_hint(..., "dp", None,
+# "tp")`` the decode write path pins, so host-placed caches and
+# program-produced caches agree on layout — per-device KV HBM drops
+# ~1/tp and XLA never round-trips the cache through a gather.
+def _kv_leaf_sharding(mesh, ndim: int):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lambdipy_tpu.parallel.sharding import _filter_spec
+
+    return NamedSharding(mesh, _filter_spec(P(None, None, "tp"), mesh, ndim))
+
+
+def shard_kv_cache(cache, mesh):
+    """Place a host-built decode cache (list of per-layer dicts, as
+    :func:`init_decode_cache` / :func:`concat_cache_blocks` return) on
+    ``mesh``: KV leaves kv-head-sharded over ``tp``, ``index`` leaves
+    replicated. A mesh without a ``tp`` axis places everything
+    replicated — the 1-device degenerate mesh is an exact no-op."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    return [{name: jax.device_put(
+                 val, rep if name == "index"
+                 else _kv_leaf_sharding(mesh, val.ndim))
+             for name, val in entry.items()}
+            for entry in cache]
+
+
+def shard_page_arena(arena, mesh):
+    """Place a paged KV arena (:func:`init_page_arena`) on ``mesh`` —
+    same kv-head-over-``tp`` rule as :func:`shard_kv_cache`, applied to
+    the ``[n_pages, page, kv_heads, *]`` leaves."""
+    return [{name: jax.device_put(val, _kv_leaf_sharding(mesh, val.ndim))
+             for name, val in entry.items()}
+            for entry in arena]
+
+
+def validate_serving_mesh(cfg: LlamaConfig, mesh) -> None:
+    """Reject serving meshes the TP layout cannot honor. ``shard_hint``
+    silently DROPS an axis that does not divide the dim it would split —
+    correct for a training forward, but a serving bundle that declared
+    ``tp=8`` over 4 kv heads would then pay an 8-chip mesh to replicate
+    its dominant HBM object. Raise loudly instead."""
+    shape = dict(getattr(mesh, "shape", {}) or {})
+    tp = int(shape.get("tp", 1))
+    if tp <= 1:
+        return
+    bad = []
+    if cfg.kv_heads % tp:
+        bad.append(f"kv_heads={cfg.kv_heads}")
+    if cfg.heads % tp:
+        bad.append(f"heads={cfg.heads}")
+    if cfg.mlp % tp:
+        bad.append(f"mlp={cfg.mlp}")
+    if bad:
+        raise ValueError(
+            f"mesh tp={tp} does not divide {', '.join(bad)}: the "
+            "tensor-parallel layout shards attention heads and the MLP "
+            "hidden dim over tp, and the KV cache over kv_heads — pick "
+            "a tp that divides all three (or drop the mesh)")
+
+
 def slice_cache_blocks(cache, start: int, width: int):
     """Store-layout ``[start, start + width)`` sequence slices of a decode
     cache, one dict per layer (``index`` dropped) — the block-granular
@@ -531,10 +597,23 @@ def concat_cache_blocks(cfg: LlamaConfig, blocks, cache_len: int):
     position-dependent (RoPE is applied before the cache store), so the
     caller must place blocks at the absolute positions they were sliced
     from; a radix path does that by construction."""
+    from lambdipy_tpu.parallel.mesh import current_mesh
+
     total = sum(next(iter(b[0].values())).shape[1] for b in blocks)
+    # sharding-preserving under an ambient tp mesh: the assembled
+    # full-window buffer is the big allocation here — place the fresh
+    # dest kv-head-sharded BEFORE the updates, so the eager
+    # dynamic_update_slice of (tp-sharded) block slices never gathers
+    # and the registered cache costs 1/tp per device like its sources
+    mesh = current_mesh()
+    shard = (mesh is not None and mesh.shape.get("tp", 1) > 1)
     out = []
     for i in range(cfg.layers):
         dest = _empty_cache_entry(cfg, 1, cache_len)
+        if shard:
+            dest = {name: jax.device_put(
+                        val, _kv_leaf_sharding(mesh, val.ndim))
+                    for name, val in dest.items()}
         for name in blocks[0][i]:
             merged = jnp.concatenate([b[i][name] for b in blocks], axis=1)
             dest[name] = jax.lax.dynamic_update_slice(
@@ -544,23 +623,28 @@ def concat_cache_blocks(cfg: LlamaConfig, blocks, cache_len: int):
     return out
 
 
-def init_page_arena(cfg: LlamaConfig, n_pages: int, page: int):
+def init_page_arena(cfg: LlamaConfig, n_pages: int, page: int, mesh=None):
     """The paged KV arena (runtime/pagepool.py): per layer, the decode
     cache's store-layout leaves re-shaped page-major —
     ``[n_pages, page, kv_heads, head_dim]`` — with NO ``index`` leaf
     (positions live in the per-row block tables, not the storage).
     Page 0 is the reserved null page; it starts zero like everything
-    else and only ever accumulates unread garbage."""
+    else and only ever accumulates unread garbage. With ``mesh`` the
+    arena is placed kv-head-sharded over ``tp``
+    (:func:`shard_page_arena`): per-device arena HBM drops ~1/tp and
+    the paged gather/scatter programs keep the layout end to end."""
     shape = (n_pages, page, cfg.kv_heads, cfg.head_dim)
     if cfg.kv_quant == "int8":
-        return [{"k_int8": jnp.zeros(shape, jnp.int8),
-                 "k_scale": jnp.full(shape[:3] + (1,), 1e-8, jnp.float32),
-                 "v_int8": jnp.zeros(shape, jnp.int8),
-                 "v_scale": jnp.full(shape[:3] + (1,), 1e-8, jnp.float32)}
-                for _ in range(cfg.layers)]
-    return [{"k": jnp.zeros(shape, cfg.dtype),
-             "v": jnp.zeros(shape, cfg.dtype)}
-            for _ in range(cfg.layers)]
+        arena = [{"k_int8": jnp.zeros(shape, jnp.int8),
+                  "k_scale": jnp.full(shape[:3] + (1,), 1e-8, jnp.float32),
+                  "v_int8": jnp.zeros(shape, jnp.int8),
+                  "v_scale": jnp.full(shape[:3] + (1,), 1e-8, jnp.float32)}
+                 for _ in range(cfg.layers)]
+    else:
+        arena = [{"k": jnp.zeros(shape, cfg.dtype),
+                  "v": jnp.zeros(shape, cfg.dtype)}
+                 for _ in range(cfg.layers)]
+    return arena if mesh is None else shard_page_arena(arena, mesh)
 
 
 def page_kv_bytes(cfg: LlamaConfig, page: int) -> int:
@@ -587,13 +671,20 @@ def _gather_page_cache(arena, tables, window: int, page: int, index):
     masked. The gathered values are bitwise the pages' values, so every
     downstream program (the shared ``_scan_decode``, the continuation)
     sees exactly what a dense contiguous cache would hold."""
+    from lambdipy_tpu.parallel.sharding import shard_hint
+
     nb = window // page
     b = tables.shape[0]
     cols = tables[:, :nb].reshape(-1)
     out = []
     for entry in arena:
-        e = {name: jnp.take(val, cols, axis=0).reshape(
-                 b, nb * page, *val.shape[2:])
+        # the hint keeps the gathered working cache in the arena's
+        # kv-head-over-tp layout (no-op without a mesh): the page gather
+        # touches only the pages/seq dims, so the head dim never moves
+        e = {name: shard_hint(
+                 jnp.take(val, cols, axis=0).reshape(
+                     b, nb * page, *val.shape[2:]),
+                 "dp", None, "tp")
              for name, val in entry.items()}
         e["index"] = index
         out.append(e)
@@ -633,14 +724,22 @@ def copy_cache(cache):
 def prefill_into_cache(cfg: LlamaConfig, prefill_cache, batch: int, max_len: int,
                        prompt_len: int):
     """Embed a prefill cache (float entries sized prompt_len) into a
-    static max_len decode cache (quantizing when cfg.kv_quant)."""
+    static max_len decode cache (quantizing when cfg.kv_quant). The
+    shard_hint pins the embedded cache to the serving KV layout
+    (kv-heads over tp) so prefill-produced caches — the prefix store's
+    full-window entries included — leave their program tp-sharded
+    instead of whatever replicated layout propagation falls back to
+    (no-op without an ambient mesh)."""
+    from lambdipy_tpu.parallel.sharding import shard_hint
+
     out = []
     for entry in prefill_cache:
         store = _kv_store(cfg, entry["k"], entry["v"])
         dest = _empty_cache_entry(cfg, batch, max_len)
         for name, val in store.items():
-            dest[name] = jax.lax.dynamic_update_slice(
-                dest[name], val, (0, 0, 0, 0))
+            dest[name] = shard_hint(
+                jax.lax.dynamic_update_slice(dest[name], val, (0, 0, 0, 0)),
+                "dp", None, "tp")
         dest["index"] = jnp.int32(prompt_len)
         out.append(dest)
     return out
@@ -1131,6 +1230,11 @@ class LlamaServer:
         self.model = model
         self.params = params
         self.mesh = mesh
+        if mesh is not None:
+            # serving is strict where the training forward is lenient: a
+            # tp that can't shard the heads must error, not silently
+            # replicate the KV cache the operator paid a mesh to shard
+            validate_serving_mesh(model.cfg, mesh)
         self.min_bucket = min_bucket
         # optional runtime/aot.AotStore: serving programs are loaded from
         # the bundle's serialized-executable tier instead of compiled

@@ -13,6 +13,7 @@ from lambdipy_tpu.parallel.mesh import (
     flat_mesh,
     make_mesh,
     mesh_shape_for,
+    parse_mesh_spec,
 )
 from lambdipy_tpu.parallel.pipeline import (
     merge_microbatches,
@@ -22,6 +23,7 @@ from lambdipy_tpu.parallel.pipeline import (
 )
 from lambdipy_tpu.parallel.sharding import (
     ShardingRules,
+    device_bytes,
     named_sharding,
     shard_batch,
     shard_params,
@@ -30,11 +32,13 @@ from lambdipy_tpu.parallel.sharding import (
 __all__ = [
     "MESH_AXES",
     "ShardingRules",
+    "device_bytes",
     "flat_mesh",
     "make_mesh",
     "merge_microbatches",
     "mesh_shape_for",
     "named_sharding",
+    "parse_mesh_spec",
     "pipeline_apply",
     "shard_batch",
     "shard_params",

@@ -137,3 +137,60 @@ def mesh_shape_for(n_devices: int, *, tp: int | None = None,
     if n_devices % denom:
         raise ValueError(f"{n_devices} devices not divisible by tp*sp*pp={denom}")
     return {"dp": n_devices // denom, "pp": pp, "tp": tp, "sp": sp}
+
+
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """Parse a serving mesh declaration into ``{axis: size}``.
+
+    The one grammar shared by the ``mesh`` bundle extra, the
+    ``LAMBDIPY_MESH`` env var, and ``lambdipy serve --mesh``:
+
+    - ``"tp=2"`` / ``"tp=2,sp=1"`` / ``"dp=2 tp=4"``  explicit axes
+      (comma or whitespace separated; axis names from :data:`MESH_AXES`)
+    - ``"2"``                                          bare tensor-parallel
+      width (the dominant serving shape)
+    - ``"2x2"``                                        ``dp x tp`` grid
+      (the ROADMAP's bundle shorthand)
+    - ``""`` / ``"0"`` / ``"1"`` / ``"off"`` / ``"none"``  no mesh
+
+    Size-1 axes are dropped (they would be omitted from the Mesh anyway);
+    an all-size-1 spec means single-device serving and returns ``{}``.
+    Unknown axes and non-positive sizes raise ``ValueError`` — a typo'd
+    mesh must never silently serve replicated.
+    """
+    s = (spec or "").strip().lower()
+    if s in ("", "0", "1", "off", "none"):
+        return {}
+    if "x" in s and "=" not in s:
+        try:
+            dims = [int(tok) for tok in s.split("x")]
+        except ValueError:
+            raise ValueError(f"unparseable mesh spec {spec!r}") from None
+        if len(dims) != 2:
+            raise ValueError(
+                f"grid mesh spec must be AxB (dp x tp), got {spec!r}")
+        shape = {"dp": dims[0], "tp": dims[1]}
+    elif "=" not in s:
+        try:
+            shape = {"tp": int(s)}
+        except ValueError:
+            raise ValueError(f"unparseable mesh spec {spec!r}") from None
+    else:
+        shape = {}
+        for tok in s.replace(",", " ").split():
+            axis, _, val = tok.partition("=")
+            if not _ or axis not in MESH_AXES:
+                raise ValueError(
+                    f"unknown mesh axis {axis!r} in {spec!r}; "
+                    f"known: {MESH_AXES}")
+            try:
+                shape[axis] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"mesh axis {axis} has non-integer size {val!r}"
+                ) from None
+    for axis, size in shape.items():
+        if size < 1:
+            raise ValueError(
+                f"mesh axis {axis} must be >= 1, got {size}")
+    return {a: n for a, n in shape.items() if n > 1}

@@ -144,6 +144,33 @@ def shard_hints_suppressed() -> bool:
     return _HINTS_DISABLED.get()
 
 
+def device_bytes(tree) -> tuple[int, int]:
+    """``(per_device_max, logical_total)`` bytes of a pytree of jax
+    arrays: ``logical_total`` is the unsharded footprint (sum of
+    ``nbytes``); ``per_device_max`` sums each leaf's addressable shard
+    bytes per device and takes the busiest device — the number HBM
+    capacity planning actually cares about. A replicated leaf costs its
+    full ``nbytes`` on every device; a tp-sharded one 1/tp. Host-only
+    metadata reads — never touches device data."""
+    import jax
+
+    per_dev: dict = {}
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            continue
+        total += int(nbytes)
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            per_dev[None] = per_dev.get(None, 0) + int(nbytes)
+            continue
+        for sh in shards:
+            key = getattr(sh.device, "id", sh.device)
+            per_dev[key] = per_dev.get(key, 0) + int(sh.data.nbytes)
+    return (max(per_dev.values()) if per_dev else 0), total
+
+
 def shard_batch(batch, mesh: Mesh, axis: str = "dp"):
     """Shard the leading (batch) dim of every leaf over the data axes."""
 

@@ -200,7 +200,58 @@ class ContinuousBatcher:
             from lambdipy_tpu.models.llama import _next_bucket
 
             self.spec_k = max(2, _next_bucket(int(spec_k), 2))
+        # spec verify chunks are multi-token steps, which the
+        # sequence-parallel decode path cannot serve (spdecode is a
+        # one-token formulation): under an sp mesh every verify would
+        # silently replicate the sequence-sharded cache. Stand DOWN the
+        # spec knob instead — observable through the same per-reason
+        # counter the other sp stand-downs use, never silent.
+        srv_mesh = getattr(server, "mesh", None)
+        if self.spec_k and srv_mesh is not None \
+                and dict(getattr(srv_mesh, "shape", {})).get("sp", 1) > 1 \
+                and getattr(cfg, "attn_backend", "dense") == "ring":
+            from lambdipy_tpu.parallel.spdecode import note_standdown
+
+            note_standdown("spec_k_under_sp_mesh")
+            log.warning(
+                "engine spec_k=%d stands down: the mesh's sp axis serves "
+                "decode through sequence-parallel one-token steps, and a "
+                "multi-token verify chunk would replicate the sharded KV "
+                "cache (reason=spec_k_under_sp_mesh on /metrics)",
+                self.spec_k)
+            self.spec_k = 0
         self.spec_ngram = max(1, int(spec_ngram))
+        # -- tensor-parallel sharded serving (ROADMAP direction 3) -----------
+        # a server with a multi-device mesh runs every engine program
+        # SPMD: params and the KV carry are tp-sharded, the host-side
+        # logic above the dispatch boundary (slots, block tables, window
+        # buckets, joiners) is unchanged. batching.mesh publishes the
+        # layout + live per-device HBM split.
+        self.mesh_stats = None
+        if srv_mesh is not None and getattr(srv_mesh, "devices", None) is not None \
+                and srv_mesh.devices.size > 1:
+            from lambdipy_tpu.runtime.metrics import MeshStats
+
+            shape = {a: int(n) for a, n in dict(srv_mesh.shape).items()
+                     if int(n) > 1}
+            tp = shape.get("tp", 1)
+            self.mesh_stats = MeshStats()
+            self.mesh_stats.set_layout(
+                shape=shape, devices=int(srv_mesh.devices.size),
+                # Megatron layout: per decoded token, one all-reduce
+                # for the vocab-sharded embedding lookup, one after
+                # o_proj + one after down_proj per layer, plus the
+                # lm_head logits all-gather per select (analytic count;
+                # 0 without a tp axis)
+                collectives_per_segment=(
+                    self.segment * (2 * cfg.layers + 2) if tp > 1 else 0))
+            try:
+                from lambdipy_tpu.parallel.sharding import device_bytes
+
+                per_dev, total = device_bytes(server.params)
+                self.mesh_stats.set_param_bytes(per_dev, total)
+            except Exception:  # noqa: BLE001 — observability only
+                pass
         # ONE SpecDecodeStats serves the solo spec path and this engine
         # (the server owns it); a server without one (stub adapters in
         # tests) gets a private instance
@@ -325,6 +376,15 @@ class ContinuousBatcher:
         cache = init_decode_cache(cfg, b, self.cache_len)
         for entry in cache:
             entry["index"] = jnp.zeros((b,), jnp.int32)
+        mesh = getattr(self.server, "mesh", None)
+        if mesh is not None and self.mesh_stats is not None:
+            # place the B-slot cache kv-head-sharded from birth: the
+            # engine's dominant HBM object costs 1/tp per device, and
+            # the segment programs' in-program hints keep the layout
+            # across every carry update (no per-segment reshard)
+            from lambdipy_tpu.models.llama import shard_kv_cache
+
+            cache = shard_kv_cache(cache, mesh)
         tok, lp, pos, done, keys = scalars
         return (tok, lp, cache, pos, done, keys)
 
@@ -1110,6 +1170,8 @@ class ContinuousBatcher:
                     # RESET state would corrupt the replay
                     raise _StaleEngine()
                 self.segments_run += 1
+                if self.mesh_stats is not None:
+                    self.mesh_stats.record_segment()
                 for slot, entry in rec["rows"]:
                     # per-row accepted width: everything for a plain
                     # segment; counts_h[slot] (1..kb) for a verify step
@@ -1960,5 +2022,26 @@ class ContinuousBatcher:
                     "prefix_joins": self.prefix_joins,
                     "active_rows": active,
                     "waiting_joiners": len(self._joiners),
+                    **({"mesh": self._mesh_report_locked()}
+                       if self.mesh_stats is not None else {}),
                     **({"page_pool": self.pool.stats()}
                        if self.pool is not None else {})}
+
+    def _mesh_report_locked(self) -> dict:
+        """``batching.mesh``: refresh the KV byte gauges from the LIVE
+        engine state (the current carry's cache, or the paged arena)
+        before reporting — shard metadata reads only, no device data.
+        Caller holds the engine lock, so the carry can't swap under
+        the read."""
+        try:
+            from lambdipy_tpu.parallel.sharding import device_bytes
+
+            if self.pool is not None:
+                arena = getattr(self.pool, "arena", None)
+                if arena is not None:
+                    self.mesh_stats.set_kv_bytes(*device_bytes(arena))
+            elif self._carry is not None:
+                self.mesh_stats.set_kv_bytes(*device_bytes(self._carry[2]))
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+        return self.mesh_stats.report()

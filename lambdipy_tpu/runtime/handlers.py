@@ -197,8 +197,21 @@ def _maybe_shard(adapter, params, spec: dict):
     for v in mesh_shape.values():
         needed *= v
     if len(jax.devices()) < needed:
-        return jax.device_put(params), None  # degrade to single-device
-    mesh = make_mesh(mesh_shape)
+        # degrade to single-device — LOUDLY: the operator declared a
+        # mesh and is getting replicated serving instead (the usual
+        # cause on CPU: XLA_FLAGS host-device forcing not set)
+        from lambdipy_tpu.utils.logs import get_logger
+
+        get_logger("lambdipy.handlers").warning(
+            "mesh %s needs %d devices but only %d are visible: "
+            "degrading to SINGLE-DEVICE serving (meta.sharded=false)",
+            mesh_shape, needed, len(jax.devices()))
+        return jax.device_put(params), None
+    # the first `needed` devices, not all of them: a host with more
+    # chips than the declared mesh (or a CPU with forced host devices)
+    # must still honor the bundle's shape instead of erroring on the
+    # device-count mismatch
+    mesh = make_mesh(mesh_shape, devices=jax.devices()[:needed])
     return shard_params(params, mesh, adapter.tp_rules), mesh
 
 
@@ -299,6 +312,25 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     import numpy as np
 
     extra = spec.get("extra") or {}
+    # tensor-parallel sharded serving (ROADMAP direction 3): the `mesh`
+    # bundle extra ("tp=2", "2x2", "tp=2,sp=1"...) — or LAMBDIPY_MESH,
+    # the `lambdipy serve --mesh` bridge; an explicit extra wins over
+    # the env like every other knob — resolves into the spec-level mesh
+    # shape `_maybe_shard` places params by. The whole serve stack then
+    # runs SPMD over the mesh: attention heads / MLP hidden shard over
+    # tp, the KV cache over kv_heads, host-side engine logic unchanged.
+    # CPU testing: XLA_FLAGS=--xla_force_host_platform_device_count=N.
+    import os as _os_env
+
+    raw_mesh = extra.get("mesh", _os_env.environ.get("LAMBDIPY_MESH"))
+    if raw_mesh is not None:
+        from lambdipy_tpu.parallel.mesh import parse_mesh_spec
+
+        # an explicit knob REPLACES any spec-level [payload.mesh] —
+        # including replacing it with nothing: `--mesh off` (parse ->
+        # {}) must actually serve single-device, not silently keep the
+        # bundle's declared mesh
+        spec = {**spec, "mesh": parse_mesh_spec(str(raw_mesh))}
     # Cold-start overlap (VERDICT r5 #5): AOT executable deserialization
     # + remote program loads need no weights, and the bulk weight upload
     # needs no programs — run them CONCURRENTLY instead of serially (at
@@ -498,8 +530,12 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 page_pool = PagePool(
                     n_pages=n_pages, page=page,
                     page_bytes=page_kv_bytes(cfg_m, page),
-                    make_arena=(lambda n=n_pages, p=page:
-                                init_page_arena(cfg_m, n, p)),
+                    # a meshed payload's arena is born kv-head-sharded
+                    # (per-device arena HBM ~1/tp); page_bytes stays the
+                    # LOGICAL page size — the pool's capacity accounting
+                    # is mesh-agnostic by design
+                    make_arena=(lambda n=n_pages, p=page, m=mesh:
+                                init_page_arena(cfg_m, n, p, mesh=m)),
                     window_pages=window_pages)
             batcher = continuous = ContinuousBatcher(
                 server, slots=int(extra.get("batch_max", 8)),
@@ -1067,7 +1103,10 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                          if continuous is not None else None),
         meta={
             "model": spec["model"], "quant": spec.get("quant"),
-            "sharded": mesh is not None, "tokenizer": tokenizer is not None,
+            "sharded": mesh is not None,
+            "mesh": ({a: int(n) for a, n in dict(mesh.shape).items()}
+                     if mesh is not None else None),
+            "tokenizer": tokenizer is not None,
             "compile_once": server is not None,
             "streaming": server is not None,
             "prefix_cache": prefix_store is not None,

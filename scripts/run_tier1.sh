@@ -42,6 +42,10 @@
 # paged — plus the >1.5x tok/s claim on a repetitive-continuation
 # workload with acceptance counters published under batching.spec).
 #
+# Phase 11 is the SHARDED-SERVING sweep (bench.py --mesh over 2 forced
+# CPU host devices: bitwise tp=2-vs-tp=1 parity across the same matrix,
+# plus the per-device KV/param HBM halving gate from batching.mesh).
+#
 # Every phase prints its wall-clock so the budget breakdown is visible
 # in the log (ROADMAP open item: phase 2 runs close to its 870 s cap).
 
@@ -196,4 +200,20 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 10"
+
+# Phase 11: sharded-serving smoke — bench.py --mesh forces 2 CPU host
+# devices and exits nonzero if any tp=2 engine output diverges bitwise
+# from the single-device path (greedy + seeded-sampled, cold + prefix
+# hits, streamed, concurrent, depths 1-2, dense + paged), or if the
+# live batching.mesh gauges show per-device KV/param bytes above 0.55x
+# their replicated footprint (the 1/tp HBM split sharded serving
+# exists for). tp=1-vs-tp=2 CPU tok/s prints in the JSON line
+# (informational: tiny-dim CPU collectives are expected to lose).
+phase_begin "phase 11: sharded serving mesh sweep (bench.py --mesh)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --mesh; then
+    echo "FATAL: bench.py --mesh sweep failed" >&2
+    exit 1
+fi
+phase_end "phase 11"
 exit 0

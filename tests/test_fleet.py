@@ -129,7 +129,9 @@ class StubReplica:
         self.cfg = {"shed": False, "draining": False, "warming": False,
                     "delay_s": 0.0, "retry_after": 1, "pid": 1000,
                     "prefix_cache": {"hits": 0, "misses": 0,
-                                     "hit_tokens": 0}}
+                                     "hit_tokens": 0},
+                    "spec": {"sp_standdown": 0,
+                             "sp_standdown_reasons": {}}}
         self.invokes = 0
         self.bodies = []  # (path, parsed body) of every POST received
         stub = self
@@ -161,7 +163,8 @@ class StubReplica:
                 elif self.path == "/metrics":
                     self._send(200, {
                         "count": stub.invokes,
-                        "handler": {"prefix_cache": stub.cfg["prefix_cache"]},
+                        "handler": {"prefix_cache": stub.cfg["prefix_cache"],
+                                    "spec": stub.cfg["spec"]},
                     })
                 else:
                     self._send(404, {"ok": False})
@@ -429,6 +432,12 @@ def test_router_healthz_and_metrics_aggregation(stub_pair):
     pool.probe_all()
     s0.cfg["prefix_cache"] = {"hits": 3, "misses": 1, "hit_tokens": 96}
     s1.cfg["prefix_cache"] = {"hits": 1, "misses": 1, "hit_tokens": 32}
+    # sp-decode stand-downs aggregate BY REASON at the router (a sharded
+    # replica quietly replicating its cache must be visible fleet-wide)
+    s0.cfg["spec"] = {"sp_standdown": 2, "sp_standdown_reasons":
+                      {"attn_backend=blocked": 2}}
+    s1.cfg["spec"] = {"sp_standdown": 1, "sp_standdown_reasons":
+                      {"spec_k_under_sp_mesh": 1}}
     router = FleetRouter(pool, affinity_on=True, block=32)
     router.start_background()
     base = f"http://127.0.0.1:{router.port}"
@@ -443,6 +452,9 @@ def test_router_healthz_and_metrics_aggregation(stub_pair):
         assert m["fleet"]["prefix_cache"] == {
             "hits": 4, "misses": 2, "hit_tokens": 128,
             "hit_rate": round(4 / 6, 4)}
+        assert m["fleet"]["spec_standdown"] == {
+            "total": 3, "reasons": {"attn_backend=blocked": 2,
+                                    "spec_k_under_sp_mesh": 1}}
         assert m["router"]["completed"] == 3
         assert m["router"]["affinity"]["requests"] == 3
         assert sum(rep["routed"] for rep in m["pool"].values()) == 3

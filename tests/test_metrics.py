@@ -132,6 +132,53 @@ def test_decode_window_stats_concurrent():
     assert rep["savings_ratio"] == 0.5
 
 
+def test_mesh_stats_counters():
+    """The ``batching.mesh`` block a tensor-parallel engine publishes:
+    layout, live per-device vs replicated byte gauges with their
+    savings ratios, the analytic collective count, and safe
+    empty-state reporting (savings 1.0 = no mesh benefit claimed)."""
+    from lambdipy_tpu.runtime.metrics import MeshStats
+
+    st = MeshStats()
+    rep = st.report()
+    assert rep["shape"] == {} and rep["devices"] == 1
+    assert rep["hbm_savings"] == 1.0 and rep["param_savings"] == 1.0
+    assert rep["segments_sharded"] == 0
+
+    st.set_layout(shape={"tp": 2}, devices=2,
+                  collectives_per_segment=16 * (2 * 32 + 1))
+    st.set_kv_bytes(512, 1024)
+    st.set_param_bytes(300, 500)
+    st.record_segment()
+    st.record_segment(2)
+    rep = st.report()
+    assert rep["shape"] == {"tp": 2} and rep["devices"] == 2
+    assert rep["kv_bytes_per_device"] == 512
+    assert rep["kv_bytes_replicated"] == 1024
+    assert rep["hbm_savings"] == 0.5
+    assert rep["param_savings"] == 0.6
+    assert rep["collectives_per_segment"] == 16 * 65
+    assert rep["segments_sharded"] == 3
+
+
+def test_mesh_stats_concurrent():
+    from lambdipy_tpu.runtime.metrics import MeshStats
+
+    st = MeshStats()
+
+    def write():
+        for _ in range(200):
+            st.record_segment()
+            st.set_kv_bytes(1, 2)
+
+    threads = [threading.Thread(target=write) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert st.report()["segments_sharded"] == 800
+
+
 def test_pipeline_stats_empty_report():
     st = PipelineStats(depth=2)
     assert st.report() == {"depth": 2, "segments": 0, "dispatches": 0,
