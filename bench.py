@@ -25,6 +25,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 BASELINE_P50_MS = 15.0  # BASELINE.json north star for ResNet-50 on v5e-1
@@ -476,6 +477,494 @@ def _build_fleet_bundle(tmp, *, n_new: int, block: int,
     bundle = tmp / "bundle"
     assemble_bundle(result, bundle, with_payload=True)
     return bundle
+
+
+def _build_disagg_bundle(tmp, *, n_new: int, block: int,
+                         name: str = "disagg-bench"):
+    """The tiny llama bundle the disaggregation sweep serves: prefix
+    cache on (the ship surface rides it), CONTINUOUS batching (the
+    decode-depth story), deterministic init params so every replica is
+    bitwise the same server."""
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.bundle import assemble_bundle
+    from lambdipy_tpu.recipes.schema import load_recipe_dict
+
+    doc = {
+        "schema": 1, "name": name, "version": "0.1",
+        "device": "any", "base_layer": "jax-tpu", "requires": [],
+        "payload": {
+            "model": "llama-tiny",
+            "handler": "lambdipy_tpu.runtime.handlers:generate_handler",
+            "params": "init", "dtype": "float32",
+            # a 512-token window + wider hidden than the test-tiny
+            # defaults: the isolation claim needs prefill that COSTS
+            # something relative to a decode step (a 256-token cold
+            # walk is ~8 chunked forwards over a growing context),
+            # which the 128-token test config cannot express
+            # sched_max_concurrency=1 serializes each replica like the
+            # one accelerator it stands in for: a request occupies the
+            # replica for its service time, so prefill occupancy and
+            # decode occupancy genuinely contend — the mechanism the
+            # phase split exists to separate (on a shared-CPU box,
+            # concurrent slots would hide occupancy behind the OS
+            # scheduler and the isolation claim would measure nothing)
+            "extra": {"max_new_tokens": str(n_new), "serve_aot": "0",
+                      "warm_group_prefill": "0",
+                      "prefix_cache_mb": "64",
+                      "prefix_block": str(block),
+                      "max_len": "512", "hidden": "128",
+                      "sched_max_concurrency": "1",
+                      "batch_mode": "continuous",
+                      "batch_max": "4", "batch_segment": "8"},
+        },
+    }
+    result = build_recipe(load_recipe_dict(doc), tmp / "work",
+                          run_smoke=False)
+    bundle = tmp / "bundle"
+    assemble_bundle(result, bundle, with_payload=True)
+    return bundle
+
+
+def _spawn_replica_proc(bundle, *, env_extra=None, tag="r",
+                        ready_timeout=300.0):
+    """Boot one bundle server as a SUBPROCESS (own jax client, own
+    XLA threadpool — the disaggregation claim is about isolating
+    replica workloads, which in-process replicas sharing one device
+    client cannot honestly show). Returns (proc, url, stderr_path)."""
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [here] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p])
+    env.update(env_extra or {})
+    errf = tempfile.NamedTemporaryFile(
+        prefix=f"lambdipy-disagg-{tag}-", suffix=".stderr", delete=False)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lambdipy_tpu.runtime.server",
+         str(bundle)],
+        stdout=subprocess.PIPE, stderr=errf, text=True, env=env)
+    ready: dict = {}
+
+    def _reader():
+        for line in proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if msg.get("ready"):
+                ready.update(msg)
+                return
+
+    t = threading.Thread(target=_reader, daemon=True)
+    t.start()
+    t.join(timeout=ready_timeout)
+    if not ready:
+        proc.kill()
+        tail = ""
+        try:
+            with open(errf.name) as f:
+                tail = f.read()[-800:]
+        except OSError:
+            pass
+        raise RuntimeError(
+            f"replica {tag} never printed its ready line: {tail}")
+    return proc, f"http://127.0.0.1:{ready['port']}", errf.name
+
+
+def disagg_record(*, block: int = 64, prefix_len: int = 64,
+                  suffix_len: int = 8, n_new: int = 24,
+                  parity_requests: int = 6, decode_window_s: float = 6.0,
+                  decode_new: int = 64, burst_len: int = 449,
+                  burst_requests: int = 8, walk_ms: float = 90.0,
+                  min_speedup: float = 1.2) -> dict:
+    """Disaggregated prefill/decode sweep (CPU-runnable, SUBPROCESS
+    replicas). Three claims, each a hard assert:
+
+    1. PARITY — a split fleet (1 decode-class + 1 prefill-class replica
+       behind the phase-split router) answers BITWISE what one replica
+       answers directly: greedy + seeded-sampled, dense + paged KV, with
+       real ships observed (router decode_dispatches > 0; on the paged
+       fleet the decode replica's imports are zero-copy page inserts).
+    2. ISOLATION — under a concurrent cold-prefill burst, the split
+       fleet's decode throughput is >= ``min_speedup`` x the MIXED fleet
+       of the same two replicas: prefill bursts land on the prefill
+       class (the export IS the prefill), so the decode replica's batch
+       keeps streaming instead of stalling behind walk prefills.
+    3. DEGRADATION — with every ship failing (injected ``kv_ship``
+       fault), the whole burst still completes bitwise with ZERO
+       client-visible errors: a dead ship path costs mixed-mode local
+       prefill, never a request (the --chaos-fleet bar).
+    """
+    import tempfile
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+    from pathlib import Path
+
+    import numpy as np
+
+    from lambdipy_tpu.fleet import DECODE, MIXED, PREFILL, FleetRouter, \
+        ReplicaPool
+    from lambdipy_tpu.runtime.faults import FaultPlan
+
+    tmp = Path(tempfile.mkdtemp(prefix="lambdipy-disagg-bench-"))
+    bundle = _build_disagg_bundle(tmp, n_new=n_new, block=block)
+    rng = np.random.default_rng(0)
+
+    def post(base, path, payload, timeout=300):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def completion(base, row, *, max_tokens, **kw):
+        out = post(base, "/v1/completions",
+                   {"prompt": [int(t) for t in row],
+                    "max_tokens": max_tokens,
+                    "temperature": kw.get("temperature", 0),
+                    **({"seed": kw["seed"]} if "seed" in kw else {}),
+                    **({"top_p": kw["top_p"]} if "top_p" in kw else {})})
+        return out["choices"][0]["tokens"]
+
+    def metrics(base):
+        with urllib.request.urlopen(f"{base}/metrics",
+                                    timeout=60) as resp:
+            return json.loads(resp.read())
+
+    def boot_pair(env_extra=None, tag=""):
+        out = [None, None]
+        errs: list = []
+
+        def boot(i, t):
+            try:
+                out[i] = _spawn_replica_proc(bundle, env_extra=env_extra,
+                                             tag=t)
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                errs.append(e)
+
+        threads = [threading.Thread(target=boot, args=(i, f"{tag}{i}"))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            for rec in out:
+                if rec is not None:
+                    rec[0].kill()
+            raise errs[0]
+        return out
+
+    def split_router(pool_specs, *, faults=None):
+        pool = ReplicaPool(probe_interval=0.5, fail_threshold=2,
+                           probe_timeout=10.0)
+        for name, url, role in pool_specs:
+            pool.attach(name, url, role=role)
+        pool.probe_all()
+        pool.start()
+        router = FleetRouter(pool, affinity_on=True, block=block,
+                             max_retries=2, request_timeout=300,
+                             faults=faults or FaultPlan.empty())
+        return router.start_background(), pool
+
+    result: dict = {"mode": "disagg", "block": block, "n_new": n_new}
+
+    # ---- claim 1: bitwise parity, dense + paged -----------------------------
+    for paged in (False, True):
+        label = "paged" if paged else "dense"
+        # synthetic prefill device time (the PR-5 synthetic-RTT idiom):
+        # every cold-walk chunk pays walk_ms through the deterministic
+        # prefix_walk fault site, on EVERY replica identically. The
+        # bench box is a single shared CPU, where real prefill FLOPs
+        # are zero-sum across replica processes and isolation would be
+        # unmeasurable; modeled device time occupies only the replica
+        # that runs the prefill — which is exactly the resource the
+        # phase split moves. Exports pay it too (the export IS the
+        # prefill), so the split fleet gets no free lunch.
+        env_extra = {"LAMBDIPY_FAULT":
+                     f"prefix_walk:delay@ms={walk_ms:g},n=inf"}
+        if paged:
+            # arena sized to the dense engine's footprint plus headroom
+            # for store-owned imported pages (imports alloc strictly)
+            env_extra.update({"LAMBDIPY_KV_PAGED": "1",
+                              "LAMBDIPY_KV_PAGES": "96"})
+        (pd, dec_url, _), (pp, pre_url, _) = boot_pair(env_extra, label)
+        try:
+            groups = [
+                _shared_prefix_rows(rng, n_requests=parity_requests,
+                                    prefix_len=prefix_len,
+                                    suffix_len=suffix_len, vocab=500)
+                for _ in range(2)]
+            rows = [r for g in groups for r in g]
+            kws = [{}, {"temperature": 0.9, "seed": 7, "top_p": 0.9}]
+            # reference = the PREFILL replica hit directly (identical
+            # init params -> bitwise-identical servers); asking it also
+            # pre-warms its radix store, which is exactly the state the
+            # export leg serves from
+            refs = {}
+            for kw in kws:
+                for row in rows:
+                    refs[(tuple(row), tuple(sorted(kw)))] = completion(
+                        pre_url, row, max_tokens=n_new, **kw)
+            router, pool = split_router(
+                [("dec", dec_url, DECODE), ("pre", pre_url, PREFILL)])
+            base = f"http://127.0.0.1:{router.port}"
+            try:
+                mismatches = []
+
+                def one(args):
+                    row, kw = args
+                    got = completion(base, row, max_tokens=n_new, **kw)
+                    if got != refs[(tuple(row), tuple(sorted(kw)))]:
+                        mismatches.append((row[:4], kw))
+
+                jobs = [(row, kw) for kw in kws for row in rows]
+                with ThreadPoolExecutor(max_workers=4) as ex:
+                    list(ex.map(one, jobs))
+                if mismatches:
+                    raise AssertionError(
+                        f"disagg {label} parity broke: split-fleet "
+                        f"tokens != direct for {mismatches[:3]}")
+                rep = router.disagg.report()
+                if rep["decode_dispatches"] < 1:
+                    raise AssertionError(
+                        f"disagg {label}: no ship ever landed "
+                        f"({rep}) — the parity run tested nothing")
+                dec_m = metrics(dec_url)
+                ship = dec_m["handler"]["batching"]["disagg"]
+                if ship["imports"] < 1:
+                    raise AssertionError(
+                        f"disagg {label}: decode replica saw no "
+                        f"imports: {ship}")
+                if paged and ship["imports_zero_copy"] < 1:
+                    raise AssertionError(
+                        f"disagg paged: imports were not zero-copy "
+                        f"page inserts: {ship}")
+                result[f"parity_{label}"] = {
+                    "requests": len(jobs),
+                    "ships": rep["ships"],
+                    "ship_bytes_ewma": rep["ship_bytes_ewma"],
+                    "ship_ms_ewma": rep["ship_ms_ewma"],
+                    "decode_imports": ship["imports"],
+                    "zero_copy": ship["imports_zero_copy"],
+                    "fallbacks": rep["fallbacks"],
+                }
+            finally:
+                router.stop()
+                pool.close()
+            if not paged:
+                # ---- claims 2 + 3 ride the dense pair -------------------
+                result["throughput"] = _disagg_throughput(
+                    dec_url, pre_url, block=block,
+                    decode_window_s=decode_window_s,
+                    decode_new=decode_new, burst_len=burst_len,
+                    min_speedup=min_speedup, split_router=split_router,
+                    completion=completion, rng=rng)
+                result["ship_failure"] = _disagg_ship_failure(
+                    dec_url, pre_url, block=block, n_new=4,
+                    burst_len=burst_len, burst_requests=burst_requests,
+                    split_router=split_router, completion=completion,
+                    rng=rng)
+        finally:
+            for p in (pd, pp):
+                p.kill()
+    result["passed"] = True
+    import jax
+
+    result["platform"] = jax.devices()[0].platform
+    return result
+
+
+def _disagg_rows(rng, *, n, length, vocab=500):
+    return [[int(t) for t in rng.integers(1, vocab, size=length)]
+            for _ in range(n)]
+
+
+def _disagg_throughput(dec_url, pre_url, *, block, decode_window_s,
+                       decode_new, burst_len, min_speedup, split_router,
+                       completion, rng, burst_interval_ms=500.0,
+                       max_bursts=80):
+    """Claim 2: decode tok/s under a concurrent cold-prefill burst,
+    split fleet vs the SAME two replicas as a mixed fleet.
+
+    Two load-generation rules keep the comparison honest and the gate
+    stable on a shared CPU box:
+
+    - The burst load is OPEN-LOOP: a scheduler fires one fresh cold
+      prompt (distinct ~448-token prefix — every one ships) every
+      ``burst_interval_ms`` for the whole window, regardless of how
+      fast the fleet absorbs them. A closed loop would self-pace to
+      each mode's own prefill latency and offer the slower fleet LESS
+      load — exactly backwards for an isolation comparison. Every
+      issued burst must complete (zero-loss bar) before the routers
+      stop.
+    - The decode stream runs for a FIXED WALL WINDOW
+      (``decode_window_s``), not a fixed request count: tok/s is
+      completed decode tokens over the actual window, so a few slow
+      requests stretch the denominator instead of ending the
+      measurement early.
+    """
+    import numpy as np
+    from concurrent.futures import ThreadPoolExecutor
+
+    from lambdipy_tpu.fleet import DECODE, MIXED, PREFILL
+
+    out = {}
+    for mode, roles in (("mixed", (MIXED, MIXED)),
+                        ("split", (DECODE, PREFILL))):
+        router, pool = split_router(
+            [("dec", dec_url, roles[0]), ("pre", pre_url, roles[1])])
+        base = f"http://127.0.0.1:{router.port}"
+        try:
+            # fresh token namespaces per mode: no cross-mode cache
+            # warmth (each mode pays its own cold prefix insert)
+            prefix = _disagg_rows(rng, n=1, length=block)[0]
+            dec_rows = [prefix + _disagg_rows(rng, n=1, length=8)[0]
+                        for _ in range(64)]
+            # off-the-clock warm: the decode prefix lands in its
+            # affinity target's radix store, and one burst-shaped
+            # request compiles the chunked-prefill + suffix-1 joiner
+            # programs in BOTH modes so neither measurement pays a
+            # first-use compile
+            completion(base, dec_rows[0], max_tokens=decode_new)
+            completion(base, _disagg_rows(rng, n=1,
+                                          length=burst_len)[0],
+                       max_tokens=1)
+            stop = threading.Event()
+            done = [0]
+            burst_threads: list = []
+            burst_errors: list = []
+
+            def burst_once(row):
+                try:
+                    completion(base, row, max_tokens=1)
+                    done[0] += 1
+                except Exception as e:  # noqa: BLE001 — a lost burst
+                    burst_errors.append(f"{type(e).__name__}: {e}")
+
+            def burst_scheduler():
+                # rows are drawn HERE (one thread) so the shared rng
+                # never races; each burst gets its own worker thread
+                while not stop.is_set() and \
+                        len(burst_threads) < max_bursts:
+                    row = _disagg_rows(rng, n=1, length=burst_len)[0]
+                    t = threading.Thread(target=burst_once, args=(row,),
+                                         daemon=True)
+                    t.start()
+                    burst_threads.append(t)
+                    if stop.wait(burst_interval_ms / 1e3):
+                        return
+
+            tokens = [0]
+            tok_lock = threading.Lock()
+            t0 = time.monotonic()
+
+            def decode_worker(widx):
+                i = widx
+                while time.monotonic() - t0 < decode_window_s:
+                    completion(base, dec_rows[i % len(dec_rows)],
+                               max_tokens=decode_new)
+                    with tok_lock:
+                        tokens[0] += decode_new
+                    i += 2
+
+            sched = threading.Thread(target=burst_scheduler, daemon=True)
+            sched.start()
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(decode_worker, (0, 1)))
+            wall = time.monotonic() - t0
+            stop.set()
+            sched.join(timeout=10)
+            for t in burst_threads:  # zero-loss: every burst completes
+                t.join(timeout=120)
+            if burst_errors or any(t.is_alive() for t in burst_threads):
+                raise AssertionError(
+                    f"disagg throughput ({mode}): burst requests were "
+                    f"lost or wedged: {burst_errors[:3]}")
+            out[mode] = {
+                "decode_tok_s": round(tokens[0] / wall, 1),
+                "decode_tokens": tokens[0],
+                "wall_s": round(wall, 3),
+                "bursts_issued": len(burst_threads),
+                "bursts_done": done[0],
+            }
+            if roles[1] == PREFILL:
+                out["split_disagg"] = {
+                    k: router.disagg.report()[k]
+                    for k in ("ships", "ship_skips", "fallbacks",
+                              "ship_ms_ewma")}
+        finally:
+            router.stop()
+            pool.close()
+    ratio = out["split"]["decode_tok_s"] / max(
+        1e-9, out["mixed"]["decode_tok_s"])
+    out["decode_speedup"] = round(ratio, 3)
+    out["min_speedup"] = min_speedup
+    if ratio < min_speedup:
+        raise AssertionError(
+            f"disagg throughput: split-fleet decode tok/s under a "
+            f"prefill burst is only {ratio:.2f}x the mixed fleet "
+            f"(gate {min_speedup}x): {out}")
+    return out
+
+
+def _disagg_ship_failure(dec_url, pre_url, *, block, n_new, burst_len,
+                         burst_requests, split_router, completion, rng):
+    """Claim 3: every ship fails (injected router-side kv_ship fault),
+    the burst still completes bitwise with zero client-visible errors —
+    phase-split degradation is mixed-mode, never loss."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from lambdipy_tpu.fleet import DECODE, PREFILL
+    from lambdipy_tpu.runtime.faults import FaultPlan
+
+    rows = _disagg_rows(rng, n=burst_requests, length=burst_len)
+    # bitwise reference from the prefill replica hit directly (bitwise-
+    # identical server; the faulted fleet must reproduce these exactly)
+    refs = [completion(pre_url, row, max_tokens=n_new) for row in rows]
+    plan = FaultPlan.from_spec("kv_ship:exception@seg=1,n=inf")
+    router, pool = split_router(
+        [("dec", dec_url, DECODE), ("pre", pre_url, PREFILL)],
+        faults=plan)
+    base = f"http://127.0.0.1:{router.port}"
+    try:
+        errors: list = []
+
+        def one(i):
+            try:
+                got = completion(base, rows[i], max_tokens=n_new)
+                if got != refs[i]:
+                    errors.append(f"row {i}: tokens diverged")
+            except Exception as e:  # noqa: BLE001 — any error fails
+                errors.append(f"row {i}: {type(e).__name__}: {e}")
+
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            list(ex.map(one, range(len(rows))))
+        rep = router.disagg.report()
+        if errors:
+            raise AssertionError(
+                f"disagg ship-failure: client-visible damage with "
+                f"ships down: {errors[:3]}")
+        if rep["fallbacks"].get("ship_fault", 0) < 1:
+            raise AssertionError(
+                f"disagg ship-failure: the injected fault never bit "
+                f"({rep['fallbacks']}) — the case tested nothing")
+        if rep["ships"] != 0:
+            raise AssertionError(
+                "disagg ship-failure: a ship landed despite the "
+                "permanent fault")
+        return {"requests": len(rows), "delivered": len(rows),
+                "fallbacks": rep["fallbacks"], "parity": True}
+    finally:
+        router.stop()
+        pool.close()
 
 
 def fleet_record(*, replicas: int = 2, requests_per_group: int = 6,
@@ -1930,6 +2419,32 @@ def chaos_fleet_record(*, replicas: int = 2, n_new: int = 6,
     }
 
 
+def _disagg_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--disagg", action="store_true")
+    ap.add_argument("--block", type=int, default=64)
+    ap.add_argument("--n-new", type=int, default=24)
+    ap.add_argument("--parity-requests", type=int, default=6)
+    ap.add_argument("--decode-window-s", type=float, default=6.0)
+    ap.add_argument("--decode-new", type=int, default=64)
+    ap.add_argument("--burst-len", type=int, default=449)
+    ap.add_argument("--burst-requests", type=int, default=8)
+    ap.add_argument("--walk-ms", type=float, default=90.0)
+    ap.add_argument("--min-speedup", type=float, default=1.2)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(disagg_record(
+        block=args.block, n_new=args.n_new,
+        parity_requests=args.parity_requests,
+        decode_window_s=args.decode_window_s,
+        decode_new=args.decode_new, burst_len=args.burst_len,
+        burst_requests=args.burst_requests, walk_ms=args.walk_ms,
+        min_speedup=args.min_speedup)))
+    return 0
+
+
 def _chaos_fleet_main() -> int:
     import argparse
 
@@ -2224,6 +2739,14 @@ def main() -> int:
         # zero-copy prefix-hit claim (assembly bytes eliminated), and
         # the token-bounded capacity margin under a fixed HBM budget
         return _paged_main()
+    if "--disagg" in sys.argv:
+        # CPU-runnable disaggregated prefill/decode sweep (subprocess
+        # replicas): bitwise split-fleet-vs-direct parity (greedy +
+        # sampled, dense + paged, real ships observed), decode tok/s
+        # under a cold-prefill burst >= 1.2x the mixed fleet at equal
+        # replica count, and injected ship failure completing the
+        # burst with zero client-visible errors
+        return _disagg_main()
     if "--chaos-fleet" in sys.argv:
         # CPU-runnable fleet-boundary chaos matrix: router-side network
         # faults (drop/latency/mid-body/flap) + a fleet-wide shed burst

@@ -553,7 +553,15 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
 @main.command("fleet")
 @click.argument("bundle")
 @click.option("--replicas", "-n", type=int, default=2, show_default=True,
-              help="supervised bundle-server replicas to run")
+              help="supervised bundle-server replicas to run (decode-"
+                   "class when --prefill-replicas > 0, mixed otherwise)")
+@click.option("--prefill-replicas", type=int, default=0, show_default=True,
+              help="additional PREFILL-class replicas (deployed as "
+                   "NAME-p0..M-1): the router splits cold requests — "
+                   "prefill runs on a prefill replica (/v1/kv/export), "
+                   "the KV blocks ship to the affinity-chosen decode "
+                   "replica, and decode packs its far deeper batch "
+                   "isolated from prefill bursts; 0 = no phase split")
 @click.option("--port", type=int, default=8080, show_default=True,
               help="router port (replicas pick their own free ports)")
 @click.option("--name", default=None,
@@ -589,12 +597,15 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
                    "`lambdipy serve --engine-watchdog`): a replica "
                    "whose device wait hangs flips its /healthz to "
                    "wedged and the pool ejects it at probe speed")
-@click.option("--attach", "attach_urls", multiple=True, metavar="NAME=URL",
+@click.option("--attach", "attach_urls", multiple=True,
+              metavar="NAME=URL[:class]",
               help="attach an externally managed replica (remote host "
                    "or existing deployment): probed/ejected/readmitted/"
                    "cache-warmed like spawned ones, but never restarted "
                    "or drained by this pool; repeatable, and with "
-                   "--replicas 0 the fleet is attach-only")
+                   "--replicas 0 the fleet is attach-only. An optional "
+                   ":class suffix (prefill|decode|mixed, default mixed) "
+                   "sets the replica's phase-split class")
 @click.option("--spill-cap", type=int, default=64, show_default=True,
               help="router spill-queue capacity: when the WHOLE fleet "
                    "sheds or nothing is routable, non-streamed requests "
@@ -622,21 +633,33 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
                    "(runtime/faults.py grammar over the route_connect/"
                    "route_body/route_latency/probe sites), default "
                    "$LAMBDIPY_FLEET_FAULT")
-def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
-              probe_interval, fail_threshold, readmit_passes, retries,
-              saturation, hedge, timeout, engine_watchdog, attach_urls,
-              spill_cap, spill_max_wait, breaker_fails, breaker_open_s,
-              retry_budget, fault_spec):
+def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
+              affinity, block, probe_interval, fail_threshold,
+              readmit_passes, retries, saturation, hedge, timeout,
+              engine_watchdog, attach_urls, spill_cap, spill_max_wait,
+              breaker_fails, breaker_open_s, retry_budget, fault_spec):
     """Serve a bundle from N supervised replicas behind one router.
 
     Spawns REPLICAS watchdogged deployments of BUNDLE, health-probes
     them (eject on failure, re-admit on recovery), and serves
     /v1/completions + /invoke on PORT with prefix-affinity routing,
-    failover retries, and fleet-wide /metrics."""
+    failover retries, and fleet-wide /metrics. With --prefill-replicas
+    (or an --attach :prefill class) the fleet serves DISAGGREGATED:
+    cold prefills run on the prefill class, their KV blocks ship to the
+    affinity-chosen decode replica, and any ship failure falls back to
+    mixed-mode local prefill."""
     import signal as _signal
     import threading as _threading
 
-    from lambdipy_tpu.fleet import FleetRouter, ReplicaPool
+    from lambdipy_tpu.fleet import (
+        DECODE,
+        MIXED,
+        PREFILL,
+        FleetError,
+        FleetRouter,
+        ReplicaPool,
+        parse_attach_spec,
+    )
     from lambdipy_tpu.runtime.deploy import LocalRuntime
     from lambdipy_tpu.runtime.faults import FaultPlan
 
@@ -644,13 +667,14 @@ def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
         raise click.ClickException(
             "--replicas must be >= 1 (or pass --attach for an "
             "attach-only fleet)")
-    attached: list[tuple[str, str]] = []
+    if prefill_replicas < 0:
+        raise click.ClickException("--prefill-replicas must be >= 0")
+    attached: list[tuple[str, str, str]] = []
     for spec in attach_urls:
-        aname, sep, aurl = spec.partition("=")
-        if not sep or not aname or not aurl.startswith("http"):
-            raise click.ClickException(
-                f"--attach wants NAME=URL (http...), got {spec!r}")
-        attached.append((aname, aurl))
+        try:
+            attached.append(parse_attach_spec(spec))
+        except FleetError as e:
+            raise click.ClickException(str(e))
     try:
         fleet_faults = (FaultPlan.from_spec(fault_spec)
                         if fault_spec is not None
@@ -671,7 +695,7 @@ def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
     # an attach-only fleet (--replicas 0) never deploys the bundle, so
     # don't require it to resolve locally
     bundle_dir = (_resolve_bundle(bundle, registry_dir)
-                  if replicas >= 1 else None)
+                  if replicas >= 1 or prefill_replicas >= 1 else None)
     fleet_name = name or bundle.split("/")[-1]
     pool = ReplicaPool(probe_interval=probe_interval,
                        fail_threshold=fail_threshold,
@@ -681,14 +705,21 @@ def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
                    if engine_watchdog is not None else None)
     spawned = []
     try:
+        runtime = LocalRuntime()
         if replicas >= 1:
-            spawned = pool.spawn_fleet(bundle_dir, replicas,
-                                       base_name=fleet_name,
-                                       runtime=LocalRuntime(),
-                                       env=replica_env,
-                                       ready_timeout=timeout)
-        for aname, aurl in attached:
-            pool.probe_one(pool.attach(aname, aurl))
+            # with a prefill class configured, the serve replicas are
+            # DECODE-class (the phase split is the point); otherwise
+            # they stay mixed and the fleet behaves exactly as before
+            spawned = pool.spawn_fleet(
+                bundle_dir, replicas, base_name=fleet_name,
+                runtime=runtime, env=replica_env, ready_timeout=timeout,
+                role=(DECODE if prefill_replicas else MIXED))
+        for i in range(prefill_replicas):
+            spawned.append(pool.spawn(
+                f"{fleet_name}-p{i}", bundle_dir, runtime=runtime,
+                env=replica_env, ready_timeout=timeout, role=PREFILL))
+        for aname, aurl, arole in attached:
+            pool.probe_one(pool.attach(aname, aurl, role=arole))
         pool.start()
         # inside the same guard: a router bind failure (port in use)
         # must not leak N supervised replica processes
@@ -709,7 +740,10 @@ def fleet_cmd(bundle, replicas, port, name, registry_dir, affinity, block,
         raise
     click.echo(json.dumps({
         "ready": True, "port": router.port, "replicas": len(spawned),
-        "attached": [a for a, _ in attached],
+        "prefill_replicas": prefill_replicas,
+        "attached": [a for a, _, _ in attached],
+        "classes": {r.name: r.role
+                    for r in pool.replicas.values()},
         "affinity": affinity, "block": block,
         "spill_cap": spill_cap, "breaker_fails": breaker_fails,
         "retry_budget": retry_budget,

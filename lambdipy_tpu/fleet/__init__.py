@@ -16,23 +16,33 @@ from lambdipy_tpu.fleet.affinity import (
     prefix_key,
     warm_prompt,
 )
+from lambdipy_tpu.fleet.affinity import ship_prompt
 from lambdipy_tpu.fleet.breaker import CircuitBreaker, RetryBudget
 from lambdipy_tpu.fleet.pool import (
+    CLASSES,
+    DECODE,
     DRAINING,
     EJECTED,
+    MIXED,
+    PREFILL,
     READY,
     STOPPED,
     FleetError,
     Replica,
     ReplicaPool,
+    parse_attach_spec,
 )
 from lambdipy_tpu.fleet.router import FleetRouter
 from lambdipy_tpu.fleet.spill import SpillQueue
 
 __all__ = [
+    "CLASSES",
+    "DECODE",
     "DEFAULT_BLOCK",
     "DRAINING",
     "EJECTED",
+    "MIXED",
+    "PREFILL",
     "READY",
     "STOPPED",
     "CircuitBreaker",
@@ -42,7 +52,9 @@ __all__ = [
     "ReplicaPool",
     "RetryBudget",
     "SpillQueue",
+    "parse_attach_spec",
     "pick_replica",
     "prefix_key",
+    "ship_prompt",
     "warm_prompt",
 ]

@@ -139,6 +139,17 @@ def warm_prompt(request: dict, *, block: int = DEFAULT_BLOCK,
     return None
 
 
+def ship_prompt(request: dict, *, block: int = DEFAULT_BLOCK,
+                key_blocks: int = DEFAULT_KEY_BLOCKS) -> list | None:
+    """:func:`warm_prompt` restricted to TOKEN heads — what the
+    disaggregated router can actually SHIP: the KV wire frame names
+    token ids and the router never tokenizes, so a string head (which
+    warm_prompt happily replays as a warm request) cannot key an
+    export. None = serve mixed-mode, no ship."""
+    head = warm_prompt(request, block=block, key_blocks=key_blocks)
+    return head if isinstance(head, list) else None
+
+
 def pick_replica(key: bytes, names) -> str | None:
     """Rendezvous-hash ``key`` onto one of ``names`` (any iterable of
     replica names). Deterministic; removing a name never remaps keys

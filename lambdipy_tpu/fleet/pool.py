@@ -44,9 +44,42 @@ DRAINING = "draining"
 EJECTED = "ejected"
 STOPPED = "stopped"
 
+# replica CLASSES for disaggregated (phase-split) serving: a "prefill"
+# replica only ever sees /v1/kv/export legs (compute-bound, bursty); a
+# "decode" replica serves the request traffic (HBM-bound, steady) from
+# shipped KV; "mixed" — the default — does both, which is exactly the
+# pre-disaggregation fleet
+PREFILL = "prefill"
+DECODE = "decode"
+MIXED = "mixed"
+CLASSES = (PREFILL, DECODE, MIXED)
+
 
 class FleetError(RuntimeError):
     pass
+
+
+def parse_attach_spec(spec: str) -> tuple[str, str, str]:
+    """``NAME=URL[:class]`` -> (name, url, class). The class suffix is
+    optional (default ``mixed``) and only recognized when it names a
+    real replica class — ``NAME=http://host:8080`` keeps its port. A
+    purely alphabetic suffix that is NOT a class raises (a typo'd
+    ``:prefil`` must not silently attach a mixed replica the operator
+    meant to dedicate); anything else — a port, an IPv6 literal's
+    ``::1]`` tail, a path — is just part of the URL, exactly what the
+    pre-class grammar accepted."""
+    name, sep, rest = spec.partition("=")
+    if not sep or not name or not rest.startswith("http"):
+        raise FleetError(
+            f"attach spec wants NAME=URL[:class] (http...), got {spec!r}")
+    url, csep, suffix = rest.rpartition(":")
+    if csep and suffix.lower() in CLASSES:
+        return name, url, suffix.lower()
+    if csep and suffix.isalpha():
+        raise FleetError(
+            f"attach spec {spec!r}: unknown replica class {suffix!r} "
+            f"(want one of {CLASSES})")
+    return name, rest, MIXED
 
 
 @dataclass
@@ -59,6 +92,11 @@ class Replica:
     url: str
     state: str = READY
     ready: bool = True
+    # replica class (prefill | decode | mixed): the router's phase-split
+    # dispatch keys on it — decode traffic never routes to a prefill-
+    # class replica (except as the last-resort mixed-mode degrade), and
+    # KV-ship export legs only target prefill-class replicas
+    role: str = MIXED
     # the replica's engine watchdog declared its device transport
     # wedged: the process answers /healthz but cannot serve — treated
     # as a FAILED probe (ejection), not a readiness flap
@@ -90,6 +128,7 @@ class Replica:
         return {
             "url": self.url,
             "state": self.state,
+            "class": self.role,
             "ready": self.ready,
             "wedged": self.wedged,
             "outstanding": self.outstanding,
@@ -130,7 +169,7 @@ class ReplicaPool:
 
     # -- membership ---------------------------------------------------------
 
-    def attach(self, name: str, url: str) -> Replica:
+    def attach(self, name: str, url: str, *, role: str = MIXED) -> Replica:
         """Register an externally managed replica (a remote host, a
         deployment the operator already made, or a test stub). Attached
         replicas are FIRST-CLASS for routing and health — probed,
@@ -138,8 +177,12 @@ class ReplicaPool:
         ones — but have a probe-only lifecycle: ``rolling_restart`` and
         ``begin_drain`` refuse them (this pool cannot redeploy a
         process it does not own), and ``stop_all`` detaches without
-        touching the remote process."""
-        r = Replica(name=name, url=url.rstrip("/"))
+        touching the remote process. ``role`` is the replica class
+        (prefill | decode | mixed) the router's phase-split keys on."""
+        if role not in CLASSES:
+            raise FleetError(
+                f"unknown replica class {role!r} (want one of {CLASSES})")
+        r = Replica(name=name, url=url.rstrip("/"), role=role)
         with self._lock:
             if name in self.replicas:
                 raise FleetError(f"replica {name!r} already in the pool")
@@ -149,7 +192,7 @@ class ReplicaPool:
     def spawn(self, name: str, bundle_dir: Path, *,
               runtime: LocalRuntime | None = None, env: dict | None = None,
               port: int = 0, ready_timeout: float = 300.0,
-              watchdog: bool = True) -> Replica:
+              watchdog: bool = True, role: str = MIXED) -> Replica:
         """Deploy one supervised replica and register it."""
         if runtime is not None:
             self.runtime = runtime
@@ -158,19 +201,21 @@ class ReplicaPool:
         dep = self.runtime.deploy(name, bundle_dir, port=port,
                                   ready_timeout=ready_timeout, env=env,
                                   watchdog=watchdog)
-        r = self.attach(name, dep.url)
+        r = self.attach(name, dep.url, role=role)
         r.managed = True
         r.spawn_env = dict(env) if env else None
         self.probe_one(r)  # fill pid/ready before the first route
-        log_event(log, "replica spawned", name=name, url=r.url)
+        log_event(log, "replica spawned", name=name, url=r.url,
+                  role=role)
         return r
 
     def spawn_fleet(self, bundle_dir: Path, n: int, *, base_name: str,
                     runtime: LocalRuntime | None = None,
                     env: dict | None = None,
-                    ready_timeout: float = 300.0) -> list[Replica]:
+                    ready_timeout: float = 300.0,
+                    role: str = MIXED) -> list[Replica]:
         return [self.spawn(f"{base_name}-r{i}", bundle_dir, runtime=runtime,
-                           env=env, ready_timeout=ready_timeout)
+                           env=env, ready_timeout=ready_timeout, role=role)
                 for i in range(int(n))]
 
     # -- health state machine -----------------------------------------------

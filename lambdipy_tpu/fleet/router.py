@@ -41,6 +41,35 @@ Streaming (``stream: true`` on ``/invoke`` ndjson or ``/v1/completions``
 SSE) is a line-wise pass-through: the replica's chunked response is
 re-framed to the client byte-identically.
 
+DISAGGREGATED (phase-split) serving: when the pool holds PREFILL-class
+replicas (``lambdipy fleet --prefill-replicas M``, or attach grammar
+``NAME=URL:prefill``), the router splits a cold request's lifecycle —
+prefill is compute-bound and bursty, decode is HBM-bound and steady, and
+co-locating them means every prefill burst stalls the decode batch.
+Before forwarding, :meth:`_maybe_ship` (1) picks the affinity-chosen
+DECODE-class replica, (2) sends the prompt's whole-block token head to a
+prefill-class replica's ``/v1/kv/export`` (that call IS the prefill:
+missing blocks prefill into the prefill replica's radix store and leave
+as a dtype/int8-scale-aware wire frame — runtime/kvwire.py), and (3)
+POSTs the frame to the decode replica's ``/v1/kv/import``, where a ship
+arrival is just a radix insert (zero-copy into arena pages under
+``--kv-paged``). The request then forwards normally; the decode replica
+longest-prefix-matches the shipped KV and serves decode from its far
+deeper batch. EVERY failure along that path — no prefill replica, a
+dead export, import backpressure from a full page arena, an injected
+``kv_ship`` fault — falls back to MIXED-mode local prefill on the
+decode replica, counted by reason in ``fleet.disagg.fallbacks``: a
+fallback is a slower request, never a lost one (the same
+zero-silent-loss bar as ``--chaos-fleet``). Ships respect the circuit
+breakers (both legs ride :meth:`_forward`) and never retry — a failed
+ship spends no retry budget, it just degrades to mixed. A per-replica
+shipped-key LRU dedupes repeat ships; an ejected replica's entry is
+cleared on readmission (its radix cache died with the worker).
+Prefill-class replicas never serve decode traffic, and affinity
+rendezvous-hashes over the decode-capable replicas only — unless NO
+decode-capable replica is routable, in which case the router degrades
+to the prefill class rather than browning out (mixed-mode again).
+
 ``GET /metrics`` aggregates every replica's own ``/metrics`` (so the
 fleet-wide prefix-cache hit rate is one read) and adds the router's
 counters (runtime/metrics.RouterStats) plus the pool's per-replica
@@ -63,11 +92,11 @@ from queue import Empty, Queue
 
 from lambdipy_tpu.fleet import affinity
 from lambdipy_tpu.fleet.breaker import CircuitBreaker, RetryBudget
-from lambdipy_tpu.fleet.pool import Replica, ReplicaPool
+from lambdipy_tpu.fleet.pool import PREFILL, Replica, ReplicaPool
 from lambdipy_tpu.fleet.spill import SPILL_DEADLINE, SpillQueue
 from lambdipy_tpu.runtime.deploy import _http_json
 from lambdipy_tpu.runtime.faults import FaultPlan, InjectedFault
-from lambdipy_tpu.runtime.metrics import RouterStats
+from lambdipy_tpu.runtime.metrics import DisaggStats, RouterStats
 from lambdipy_tpu.sched.admission import Shed
 from lambdipy_tpu.utils.logs import get_logger, log_event
 
@@ -128,8 +157,18 @@ class FleetRouter:
         self._hot: OrderedDict = OrderedDict()
         self._hot_cap = max(8, 8 * self.warm_prefixes)
         self._hot_lock = threading.Lock()
-        if self.warm_prefixes:
-            pool.on_admit = self._on_replica_admitted
+        # disaggregated (phase-split) serving: active exactly when the
+        # pool holds prefill-class replicas. The shipped-key LRU (per
+        # decode replica) dedupes repeat ships of the same prefix; an
+        # entry dies with its replica's ejection (the on_admit hook
+        # clears it on readmission — the radix cache is gone).
+        self.disagg = DisaggStats()
+        self._shipped: dict[str, OrderedDict] = {}
+        self._shipped_cap = 512
+        self._ship_lock = threading.Lock()
+        # on_admit is always hooked: it clears the shipped-key cache
+        # for a readmitted replica, then (when enabled) cache-warms it
+        pool.on_admit = self._on_replica_admitted
         self._rr = 0  # tie-break rotation for least-outstanding picks
         self._rr_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
@@ -181,16 +220,29 @@ class FleetRouter:
 
     def _pick(self, key: bytes | None, exclude: set,
               *, count_affinity: bool) -> Replica | None:
-        cands = [r for r in self.pool.routable()
-                 if r.name not in exclude and not self._breaker_blocked(r)]
+        def usable(rs):
+            return [r for r in rs if r.name not in exclude
+                    and not self._breaker_blocked(r)]
+
+        # prefill-class replicas are dedicated to export legs: request
+        # traffic routes over the decode-capable (decode/mixed) set...
+        cands = usable(r for r in self.pool.routable()
+                       if r.role != PREFILL)
         if not cands:
             # degrade to live-but-not-ready replicas (warm in flight /
             # server-side drain flag) rather than 503ing the fleet: a
             # warming replica serves fine, and a draining one sheds a
             # retryable 503 — both beat a synthetic no_replica
-            cands = [r for r in self.pool.live_fallback()
-                     if r.name not in exclude
-                     and not self._breaker_blocked(r)]
+            cands = usable(r for r in self.pool.live_fallback()
+                           if r.role != PREFILL)
+        if not cands:
+            # ...unless NOTHING decode-capable is left: a prefill-class
+            # replica is a full bundle server, and serving mixed-mode on
+            # it beats browning out the fleet (counted, never silent)
+            cands = usable(self.pool.routable()) or \
+                usable(self.pool.live_fallback())
+            if cands:
+                self.disagg.record_fallback("no_decode_replica")
         if not cands:
             return None
         chosen: Replica
@@ -204,12 +256,17 @@ class FleetRouter:
                 chosen = self._least_outstanding(cands)
             else:
                 if count_affinity:
-                    # "hit" only when the full-fleet rendezvous target
-                    # was routable: a pick among survivors after an
-                    # ejection is affinity-consistent but not a
-                    # cache-affinity hit
-                    all_names = sorted(self.pool.replicas)
-                    full_target = affinity.pick_replica(key, all_names)
+                    # "hit" only when the full-membership rendezvous
+                    # target was routable: a pick among survivors after
+                    # an ejection is affinity-consistent but not a
+                    # cache-affinity hit. Membership = decode-capable
+                    # replicas (prefill-class replicas hold export
+                    # traffic, not affinity cache).
+                    all_names = sorted(
+                        n for n, r in self.pool.replicas.items()
+                        if r.role != PREFILL)
+                    full_target = affinity.pick_replica(
+                        key, all_names or sorted(self.pool.replicas))
                     self.stats.count_affinity(
                         "hit" if full_target == target_name else "ejected")
                 chosen = target
@@ -346,11 +403,17 @@ class FleetRouter:
 
     def _on_replica_admitted(self, replica: Replica) -> None:
         """Pool hook: a replica just became routable (first probe after
-        attach/spawn, or readmission after an ejection). Warm it in the
+        attach/spawn, or readmission after an ejection). Its radix
+        cache died with the old worker, so the shipped-key dedup cache
+        must forget it — otherwise the router would skip ships the
+        replica can no longer serve from. Then warm it in the
         background — the prober thread must not block on prefills."""
-        threading.Thread(target=self._warm_replica, args=(replica,),
-                         daemon=True,
-                         name=f"fleet-warm-{replica.name}").start()
+        with self._ship_lock:
+            self._shipped.pop(replica.name, None)
+        if self.warm_prefixes:
+            threading.Thread(target=self._warm_replica, args=(replica,),
+                             daemon=True,
+                             name=f"fleet-warm-{replica.name}").start()
 
     def _warm_replica(self, replica: Replica) -> None:
         """Replay this replica's share of the fleet's hottest prefixes
@@ -364,7 +427,10 @@ class FleetRouter:
                      for k, e in self._hot.items()]
         if not items:
             return
-        names = sorted(self.pool.replicas)
+        # warm over the decode-capable membership: a prefill-class
+        # replica holds no affinity share (and gets an empty `mine`)
+        names = sorted(n for n, r in self.pool.replicas.items()
+                       if r.role != PREFILL) or sorted(self.pool.replicas)
         mine = [(hits, prompt) for k, hits, prompt in items
                 if affinity.pick_replica(k, names) == replica.name]
         mine.sort(key=lambda t: -t[0])
@@ -384,6 +450,130 @@ class FleetRouter:
                 log_event(log, "cache warm failed", replica=replica.name,
                           error=str(e))
                 return  # an unhealthy target: stop, health owns it now
+
+    # -- disaggregated prefill/decode (phase-split) ship ---------------------
+
+    def _maybe_ship(self, key: bytes | None, body: dict,
+                    headers: dict) -> None:
+        """Phase-split a cold request: run its prefill on a PREFILL-
+        class replica (``/v1/kv/export`` — the export IS the prefill)
+        and ship the resulting KV blocks to the affinity-chosen DECODE
+        replica (``/v1/kv/import`` — a radix insert, zero-copy into
+        arena pages under ``--kv-paged``). Purely an optimization:
+        every failure records a fallback reason and returns — the
+        request then serves mixed-mode (local prefill on the decode
+        replica), bitwise the same answer."""
+        replicas = self.pool.replicas.values()
+        if not any(r.role == PREFILL for r in replicas):
+            return  # disaggregation not configured: zero-cost exit
+        if not self.affinity_on or key is None:
+            # without an affinity key the forward target is a rotating
+            # least-outstanding pick — shipping to a guess would warm
+            # the wrong replica half the time
+            self.disagg.record_fallback("no_affinity_key")
+            return
+        head = affinity.ship_prompt(body, block=self.block)
+        if head is None:
+            # string prompts (the router never tokenizes) or sub-block
+            # heads: nothing the KV wire can frame
+            self.disagg.record_fallback("no_token_head")
+            return
+        routable = self.pool.routable()
+        # same breaker filter as _pick: the ship must target the replica
+        # the forward will actually choose — shipping into an open
+        # breaker would load the replica the breaker shields AND warm
+        # the wrong cache
+        decs = [r for r in routable if r.role != PREFILL
+                and not self._breaker_blocked(r)]
+        if not decs:
+            self.disagg.record_fallback("no_decode_replica")
+            return
+        target_name = affinity.pick_replica(
+            key, sorted(r.name for r in decs))
+        dec = next(r for r in decs if r.name == target_name)
+        with self._ship_lock:
+            seen = self._shipped.setdefault(dec.name, OrderedDict())
+            if key in seen:
+                seen.move_to_end(key)
+                self.disagg.count("ship_skips")
+                return
+        prefills = [r for r in routable if r.role == PREFILL
+                    and not self._breaker_blocked(r)]
+        if not prefills:
+            self.disagg.record_fallback("no_prefill_replica")
+            return
+        pre = min(prefills, key=lambda r: r.outstanding)
+        t0 = time.monotonic()
+        # export leg: the prefill replica prefills missing blocks and
+        # frames the head's KV. Ships never retry (a failed ship costs
+        # a local prefill, not a lost request — no budget to spend),
+        # but both legs ride _forward, so breakers see them.
+        try:
+            self.faults.check("kv_ship")
+            status, hdrs, frame = self._forward(
+                pre, "/v1/kv/export",
+                json.dumps({"tokens": head}).encode(), headers)
+        except Exception as e:  # noqa: BLE001 — fall back to mixed
+            if isinstance(e, InjectedFault):
+                # the kv_ship site fires BEFORE any connection opens: a
+                # simulated ship failure says nothing about the replica
+                self.disagg.record_fallback("ship_fault")
+            else:
+                if not self._is_timeout(e):
+                    self.pool.note_failure(pre)
+                self.disagg.record_fallback("export_failed")
+            log_event(log, "kv export failed, serving mixed",
+                      replica=pre.name, error=str(e))
+            return
+        if status != 200:
+            self.disagg.record_fallback(
+                "export_shed" if status in (429, 503) else
+                "export_failed")
+            return
+        self.disagg.count("prefill_dispatches")
+        # import leg: the decode replica registers the shipped blocks
+        imp_headers = {**headers,
+                       "Content-Type": "application/octet-stream"}
+        try:
+            istatus, ihdrs, ibody = self._forward(
+                dec, "/v1/kv/import", frame, imp_headers)
+        except Exception as e:  # noqa: BLE001 — fall back to mixed
+            if isinstance(e, InjectedFault):
+                self.disagg.record_fallback("ship_fault")
+            else:
+                if not self._is_timeout(e):
+                    self.pool.note_failure(dec)
+                self.disagg.record_fallback("import_failed")
+            log_event(log, "kv import failed, serving mixed",
+                      replica=dec.name, error=str(e))
+            return
+        if istatus in (429, 503):
+            # decode-side backpressure (full page arena / shedding
+            # admission): the priced-shed path — honor it by NOT
+            # forcing more KV into the replica; local prefill there is
+            # charged through its own admission instead
+            self.disagg.record_fallback("import_backpressure")
+            return
+        if istatus != 200:
+            self.disagg.record_fallback("import_failed")
+            return
+        self.disagg.record_ship(nbytes=len(frame),
+                                ms=(time.monotonic() - t0) * 1e3)
+        try:
+            res = json.loads(ibody)
+            self.disagg.record_import_result(
+                inserted=int(res.get("inserted", 0)),
+                present=int(res.get("present", 0)),
+                mode=str(res.get("mode", "dense")))
+        except (ValueError, TypeError):
+            pass  # counters are advisory; the ship itself landed
+        with self._ship_lock:
+            seen = self._shipped.setdefault(dec.name, OrderedDict())
+            seen[key] = True
+            seen.move_to_end(key)
+            while len(seen) > self._shipped_cap:
+                seen.popitem(last=False)
+        self.disagg.count("decode_dispatches")
 
     # -- request routing ----------------------------------------------------
 
@@ -422,6 +612,11 @@ class FleetRouter:
             # pre-first-byte retries, and an unfunded stream-heavy
             # workload would starve everyone down to the min floor
             self.retry_budget.record_request()
+        # phase-split dispatch (no-op without prefill-class replicas):
+        # prefill on a prefill replica, KV blocks shipped to the decode
+        # target, BEFORE the forward — streams included (the ship
+        # happens before any response bytes exist)
+        self._maybe_ship(key, body, headers)
         if body.get("stream"):
             self._route_stream(handler, path, raw, headers, key)
             return
@@ -781,6 +976,13 @@ class FleetRouter:
         # an sp mesh to shard (or whose spec_k stood down under it) must
         # be visible AT THE ROUTER, not only on the one replica's page
         sd_total, sd_reasons = 0, {}
+        # replica-side KV-ship counters (batching.disagg), aggregated so
+        # "how many imports were zero-copy" is one read at the router
+        ship_agg = {"exports": 0, "export_bytes": 0, "imports": 0,
+                    "import_bytes": 0, "import_blocks_inserted": 0,
+                    "import_blocks_present": 0, "imports_zero_copy": 0,
+                    "imports_assembled": 0, "import_backpressure": 0,
+                    "import_rejected": 0}
         for name in sorted(self.pool.replicas):
             m = per_replica.setdefault(name, None)
             if m is None:
@@ -795,6 +997,17 @@ class FleetRouter:
                 for reason, n in (sp.get("sp_standdown_reasons")
                                   or {}).items():
                     sd_reasons[reason] = sd_reasons.get(reason, 0) + int(n)
+            dg = ((m.get("handler") or {}).get("batching")
+                  or {}).get("disagg")
+            if isinstance(dg, dict):
+                blocks = dg.get("import_blocks") or {}
+                for k in ship_agg:
+                    if k == "import_blocks_inserted":
+                        ship_agg[k] += int(blocks.get("inserted", 0))
+                    elif k == "import_blocks_present":
+                        ship_agg[k] += int(blocks.get("present", 0))
+                    else:
+                        ship_agg[k] += int(dg.get(k, 0) or 0)
         total = agg["hits"] + agg["misses"]
         routable = self.pool.routable()
         router_rep = self.stats.report()
@@ -824,9 +1037,23 @@ class FleetRouter:
                 },
                 "spec_standdown": {"total": sd_total,
                                    "reasons": sd_reasons},
+                # phase-split serving: router-side dispatch/ship/EWMA
+                # counters + per-class membership + the replica-side
+                # export/import aggregate
+                "disagg": {
+                    **self.disagg.report(),
+                    "classes": self._class_counts(),
+                    "replicas": ship_agg,
+                },
             },
             "replicas": per_replica,
         }
+
+    def _class_counts(self) -> dict:
+        out: dict = {}
+        for r in self.pool.replicas.values():
+            out[r.role] = out.get(r.role, 0) + 1
+        return out
 
     # -- HTTP plumbing ------------------------------------------------------
 
@@ -896,6 +1123,10 @@ class FleetRouter:
                         "replicas": {n: r.state
                                      for n, r in sorted(
                                          pool.replicas.items())},
+                        # phase-split topology at a glance: replica
+                        # count per class; disagg is active when a
+                        # prefill-class replica exists
+                        "classes": router_self._class_counts(),
                         # replicas whose engine watchdog declared the
                         # device wedged (they answer probes but cannot
                         # serve) — the fleet-level view of the per-

@@ -712,6 +712,21 @@ def _scatter_page_cache(arena, tables, cache, page: int):
     return new
 
 
+def arena_page_slices(arena, pid: int, page: int):
+    """One arena page's per-layer KV as block slices shaped like
+    :func:`slice_cache_blocks` returns (``[1, page, kv_heads, d-or-1]``
+    per leaf) — the KV-EXPORT read primitive for paged prefix stores
+    (runtime/kvwire.py framing): a shipped page leaves the arena in the
+    exact block-slice layout a dense import would insert. Host fetch;
+    the caller must hold a pool ref on ``pid`` so a concurrent release
+    cannot recycle the page mid-read."""
+    import numpy as np
+
+    return [{name: np.asarray(val[int(pid)])[None, ...]
+             for name, val in entry.items()}
+            for entry in arena]
+
+
 def copy_cache(cache):
     """Fresh-buffer copy of a decode cache: safe to feed a DONATING
     program (``_prefix_ext_fn``) while the original stays live in a

@@ -13,12 +13,18 @@ site                      where it fires
 ``segment_fetch``         the per-segment ``device_get`` in the collector
 ``group_prefill``         the engine's ragged b-row joiner prefill
 ``prefix_assemble``       continue-prefill from a cached prefix KV
+``prefix_walk``           the prefix store's cold-walk, once per chunk
+                          dispatch (an exception fails the walk open —
+                          the request serves unrouted; a delay models
+                          per-chunk prefill device time)
 ``transport``             the ``block_until_ready`` device wait before fetch
 ``page_alloc``            the paged-KV pool taking pages for an admission
 ``route_connect``         the fleet router opening a replica connection
 ``route_body``            the router reading a replica response body
 ``route_latency``         the router's forward path (network latency site)
 ``probe``                 the replica pool's per-replica health probe
+``kv_ship``               the router's prefill→decode KV-block ship (fires
+                          once per ship attempt, before the export leg)
 ========================  ====================================================
 
 The ``route_*``/``probe`` sites live in the FLEET layer (fleet/router.py
@@ -58,9 +64,10 @@ import time
 from dataclasses import dataclass, field
 
 SITES = ("segment_dispatch", "segment_fetch", "group_prefill",
-         "prefix_assemble", "transport", "page_alloc",
+         "prefix_assemble", "prefix_walk", "transport", "page_alloc",
          # fleet-layer (router/pool) network sites
-         "route_connect", "route_body", "route_latency", "probe")
+         "route_connect", "route_body", "route_latency", "probe",
+         "kv_ship")
 KINDS = ("exception", "delay", "hang")
 _KIND_ALIASES = {"error": "exception", "raise": "exception",
                  "sleep": "delay", "stall": "delay", "block": "hang"}

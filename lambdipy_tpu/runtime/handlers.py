@@ -46,6 +46,14 @@ class HandlerState:
     # server admission 503s instead of queueing requests into a dead
     # engine. Same cost contract as warming_fn: bare attribute reads.
     engine_fault_fn: Callable[[], dict] | None = None
+    # optional disaggregated-serving KV ship surface (runtime/kvwire.py
+    # framing over the prefix store): kv_export_fn serves a request's
+    # whole-block head as a wire frame (prefilling missing blocks — on
+    # a prefill-class replica this IS the request's prefill phase);
+    # kv_import_fn registers a shipped frame in the radix tree. None =
+    # no prefix store, /v1/kv/* answers 404.
+    kv_export_fn: Callable[[dict], Any] | None = None
+    kv_import_fn: Callable[[bytes], dict] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
@@ -441,6 +449,10 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             raw_block = extra.get("prefix_block")
         prefix_block = (int(raw_block) if raw_block not in (None, "")
                         else 32)
+        # one deterministic fault plan shared by the engine's sites AND
+        # the prefix store's prefix_walk site (chaos specs arm a
+        # replica's whole serve path through one LAMBDIPY_FAULT)
+        engine_faults = None
         if batch_mode == "continuous":
             from lambdipy_tpu.runtime.continuous import ContinuousBatcher
 
@@ -537,6 +549,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                     make_arena=(lambda n=n_pages, p=page, m=mesh:
                                 init_page_arena(cfg_m, n, p, mesh=m)),
                     window_pages=window_pages)
+            engine_faults = (FaultPlan.from_spec(str(fspec))
+                             if str(fspec).strip() else None)
             batcher = continuous = ContinuousBatcher(
                 server, slots=int(extra.get("batch_max", 8)),
                 segment=int(extra.get("batch_segment", 16)),
@@ -547,8 +561,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 pipeline_depth=int(pd),
                 watchdog_s=float(wd or 0),
                 max_replays=int(mr),
-                faults=(FaultPlan.from_spec(str(fspec))
-                        if str(fspec).strip() else None),
+                faults=engine_faults,
                 page_pool=page_pool,
                 spec_k=int(sk or 0))
         elif window_ms > 0:
@@ -595,9 +608,67 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                           else None)
             prefix_store = PrefixStore(
                 server, block=prefix_block, budget_mb=mb,
-                pool=paged_pool)
+                pool=paged_pool,
+                faults=(continuous.faults if continuous is not None
+                        else None))
             if paged_pool is not None:
                 continuous.prefix_pages_fn = prefix_store.acquire_pages
+
+    # disaggregated-serving KV ship surface (ROADMAP direction 4): a
+    # prefill-class replica exports a prompt head's KV blocks as a wire
+    # frame (runtime/kvwire.py), the router ships it, and the decode
+    # replica's import is a radix insert — zero-copy into arena pages
+    # under --kv-paged. Rides the prefix store, so it exists exactly
+    # when automatic prefix caching does.
+    kv_ship_stats = None
+    kv_export = kv_import = None
+    if prefix_store is not None:
+        from lambdipy_tpu.runtime.kvwire import decode_frame, encode_frame
+        from lambdipy_tpu.runtime.metrics import KvShipStats
+        from lambdipy_tpu.runtime.pagepool import PagesExhausted
+
+        kv_ship_stats = KvShipStats()
+
+        def kv_export(req: dict):
+            """{"tokens": [...]} -> wire frame bytes, or an error dict
+            (the server maps dicts to 400s)."""
+            raw = req.get("tokens")
+            if not isinstance(raw, (list, tuple)) or not raw or \
+                    not all(isinstance(t, int) for t in raw):
+                return {"ok": False,
+                        "error": "kv export wants a flat token id list"}
+            out = prefix_store.export_blocks(list(raw))
+            if out is None:
+                return {"ok": False,
+                        "error": "no whole-block prefix to export"}
+            head, blocks = out
+            frame = encode_frame(head, prefix_store.block, blocks)
+            kv_ship_stats.record_export(tokens=len(head),
+                                        nbytes=len(frame))
+            return frame
+
+        def kv_import(data: bytes) -> dict:
+            """Wire frame -> radix insert; ValueError on garbage frames
+            (server maps to 400), PagesExhausted on a full arena
+            (server maps to the priced-shed 503)."""
+            try:
+                tokens, block, blocks = decode_frame(data)
+                if block != prefix_store.block:
+                    raise ValueError(
+                        f"frame block width {block} != this replica's "
+                        f"prefix block {prefix_store.block}")
+                res = prefix_store.import_blocks(tokens, blocks)
+            except PagesExhausted:
+                kv_ship_stats.record_backpressure()
+                raise
+            except ValueError:
+                kv_ship_stats.record_rejected()
+                raise
+            kv_ship_stats.record_import(
+                tokens=len(tokens), nbytes=len(data),
+                inserted=res["inserted"], present=res["present"],
+                mode=res["mode"])
+            return {"ok": True, **res}
 
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a
@@ -1078,6 +1149,13 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # prefix_cache_{hits,misses,hit_tokens,evictions,bytes} +
             # hit_rate — the automatic radix reuse surface
             out["prefix_cache"] = prefix_store.stats()
+        if kv_ship_stats is not None:
+            # disaggregated-serving export/import counters; nested under
+            # batching like the engine's other serve-path blocks (a
+            # batcher-less server still reports them — the ship surface
+            # rides the prefix store, not the engine)
+            out.setdefault("batching", {})["disagg"] = \
+                kv_ship_stats.report()
         if warm_state["requested"] or warm_group:
             # gate on what was ASKED (listed buckets or the engine's
             # group-prefill warm), not on what finished: an in-flight
@@ -1101,6 +1179,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         warming_fn=lambda: bool(warm_state["in_flight"]),
         engine_fault_fn=(continuous.fault_state
                          if continuous is not None else None),
+        kv_export_fn=kv_export,
+        kv_import_fn=kv_import,
         meta={
             "model": spec["model"], "quant": spec.get("quant"),
             "sharded": mesh is not None,
@@ -1110,6 +1190,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             "compile_once": server is not None,
             "streaming": server is not None,
             "prefix_cache": prefix_store is not None,
+            "kv_ship": prefix_store is not None,
             "kv_paged": (continuous is not None
                          and continuous.pool is not None),
             **({"tokenizer_error": tok_err} if tok_err else {}),

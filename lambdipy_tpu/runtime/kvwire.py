@@ -1,0 +1,166 @@
+"""KV-block wire framing for disaggregated prefill/decode serving.
+
+A prefill-class replica exports the whole-block KV of a prompt head;
+the router ships the frame to the affinity-chosen decode replica, whose
+import is just a radix insert (runtime/prefixstore.py). The frame is the
+ONLY thing that crosses the wire, so its contract is deliberately
+minimal and self-describing:
+
+``LKV1 | u32 header_len | header JSON | raw leaf bytes``
+
+The header names the covered tokens, the block width, and the per-layer
+leaf template (name, dtype, shape) — one template, because every block
+of every layer stores the same store-layout leaves (``k``/``v`` float,
+or ``k_int8``/``k_scale``/``v_int8``/``v_scale`` under ``kv_quant``:
+int8 scales travel as first-class leaves, not a side channel). The body
+is raw array bytes in a fixed order — block-major, then layer, then
+leaf name sorted — so decode needs no per-array framing.
+
+Decoding VALIDATES before any array is built: magic, header JSON, leaf
+sanity, and the exact byte length the template implies. A truncated,
+padded, or shape-lying frame raises :class:`ValueError` — the import
+endpoint maps that to a 400, and a garbage frame can never insert
+mis-shaped KV into a serving replica's radix tree.
+
+Dtypes round-trip by name through numpy, with the ml_dtypes extended
+set (``bfloat16``) resolved explicitly — a bf16 bundle ships its KV
+bitwise, not through a float32 detour.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+MAGIC = b"LKV1"
+# a header bigger than this is not a header — bound the allocation a
+# hostile length prefix could ask for before json parsing sees it
+_MAX_HEADER = 1 << 20
+
+# leaf names the store layout can produce; anything else is garbage
+_LEAF_NAMES = {"k", "v", "k_int8", "k_scale", "v_int8", "v_scale"}
+
+
+def np_dtype(name: str) -> np.dtype:
+    """``np.dtype`` from its wire name, resolving the ml_dtypes extended
+    set (bfloat16 & friends) that plain numpy does not register."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise ValueError(f"unknown KV wire dtype {name!r}") from None
+
+
+def encode_frame(tokens, block: int, blocks) -> bytes:
+    """Serialize ``blocks`` — a list over blocks, each a list over layers
+    of ``{leaf name: array [1, block, kv_heads, d-or-1]}`` (the
+    :func:`lambdipy_tpu.models.llama.slice_cache_blocks` shape) — into
+    one self-describing frame covering ``tokens`` (whole blocks)."""
+    tokens = [int(t) for t in tokens]
+    block = int(block)
+    if not blocks:
+        raise ValueError("nothing to encode: no blocks")
+    if len(tokens) != len(blocks) * block:
+        raise ValueError(
+            f"{len(tokens)} tokens do not cover {len(blocks)} x "
+            f"{block}-token blocks")
+    first = blocks[0]
+    names = sorted(first[0])
+    leaves = []
+    for name in names:
+        arr = np.asarray(first[0][name])
+        leaves.append([name, arr.dtype.name, [int(d) for d in arr.shape]])
+    header = {
+        "v": 1,
+        "tokens": tokens,
+        "block": block,
+        "layers": len(first),
+        "n_blocks": len(blocks),
+        "leaves": leaves,
+    }
+    hbytes = json.dumps(header).encode()
+    out = [MAGIC, struct.pack("<I", len(hbytes)), hbytes]
+    for blk in blocks:
+        if len(blk) != len(first):
+            raise ValueError("blocks disagree on layer count")
+        for entry in blk:
+            for name in names:
+                arr = np.ascontiguousarray(np.asarray(entry[name]))
+                out.append(arr.tobytes())
+    return b"".join(out)
+
+
+def decode_frame(data: bytes):
+    """Parse + validate a frame back into ``(tokens, block, blocks)``
+    with numpy arrays. Raises :class:`ValueError` on anything malformed
+    — the decode replica must reject garbage before it touches the
+    radix tree."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise ValueError("KV frame must be bytes")
+    data = bytes(data)
+    if len(data) < len(MAGIC) + 4 or data[:len(MAGIC)] != MAGIC:
+        raise ValueError("bad KV frame magic")
+    (hlen,) = struct.unpack_from("<I", data, len(MAGIC))
+    if hlen <= 0 or hlen > _MAX_HEADER:
+        raise ValueError(f"implausible KV frame header length {hlen}")
+    hstart = len(MAGIC) + 4
+    if len(data) < hstart + hlen:
+        raise ValueError("truncated KV frame header")
+    try:
+        header = json.loads(data[hstart:hstart + hlen])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ValueError(f"unparseable KV frame header: {e}") from None
+    if not isinstance(header, dict) or header.get("v") != 1:
+        raise ValueError("unsupported KV frame version")
+    try:
+        tokens = [int(t) for t in header["tokens"]]
+        block = int(header["block"])
+        layers = int(header["layers"])
+        n_blocks = int(header["n_blocks"])
+        leaves = [(str(n), np_dtype(str(d)), tuple(int(x) for x in s))
+                  for n, d, s in header["leaves"]]
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"bad KV frame header: {e}") from None
+    if block <= 0 or layers <= 0 or n_blocks <= 0 or not leaves:
+        raise ValueError("bad KV frame header: non-positive geometry")
+    if len(tokens) != n_blocks * block:
+        raise ValueError("KV frame tokens do not cover its blocks")
+    names = [n for n, _, _ in leaves]
+    if len(set(names)) != len(names) or not set(names) <= _LEAF_NAMES:
+        raise ValueError(f"bad KV frame leaf names {names}")
+    per_leaf = []
+    for name, dt, shape in leaves:
+        if len(shape) != 4 or shape[0] != 1 or shape[1] != block or \
+                any(d <= 0 for d in shape):
+            raise ValueError(
+                f"bad KV frame leaf shape {shape} for {name!r}")
+        n = dt.itemsize
+        for d in shape:
+            n *= d
+        per_leaf.append(n)
+    body = data[hstart + hlen:]
+    expect = n_blocks * layers * sum(per_leaf)
+    if len(body) != expect:
+        raise ValueError(
+            f"KV frame body is {len(body)} bytes, header implies "
+            f"{expect}")
+    blocks = []
+    off = 0
+    for _ in range(n_blocks):
+        blk = []
+        for _ in range(layers):
+            entry = {}
+            for (name, dt, shape), nbytes in zip(leaves, per_leaf):
+                entry[name] = np.frombuffer(
+                    body, dtype=dt, count=nbytes // dt.itemsize,
+                    offset=off).reshape(shape)
+                off += nbytes
+            blk.append(entry)
+        blocks.append(blk)
+    return tokens, block, blocks

@@ -509,6 +509,165 @@ class PagePoolStats:
 
 
 @dataclass
+class KvShipStats:
+    """Replica-side counters for the disaggregated-serving KV ship
+    surface (the ``batching.disagg`` block on ``/metrics``). Exports are
+    ``/v1/kv/export`` frames served (a prefill-class replica's output);
+    imports are ``/v1/kv/import`` frames registered in the radix tree.
+    ``import_blocks_present`` counts blocks an import found already
+    cached (the router's dedup missed, or two ships raced — the import
+    is idempotent); ``imports_zero_copy`` vs ``imports_assembled``
+    splits imports by how a later hit CONSUMES them: paged-mode imports
+    land in arena pages (a hit is an ``acquire_pages`` refcount bump,
+    zero copies), dense-mode imports are tree slices (a hit pays a
+    ``concat_cache_blocks`` assembly). ``import_backpressure`` counts
+    imports refused because the page arena was full — the priced-shed
+    path the router's fallback-to-mixed rides."""
+
+    exports: int = 0
+    export_bytes: int = 0
+    export_tokens: int = 0
+    imports: int = 0
+    import_bytes: int = 0
+    import_tokens: int = 0
+    import_blocks_inserted: int = 0
+    import_blocks_present: int = 0
+    imports_zero_copy: int = 0
+    imports_assembled: int = 0
+    import_backpressure: int = 0
+    import_rejected: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_export(self, *, tokens: int, nbytes: int) -> None:
+        with self._lock:
+            self.exports += 1
+            self.export_tokens += int(tokens)
+            self.export_bytes += int(nbytes)
+
+    def record_import(self, *, tokens: int, nbytes: int, inserted: int,
+                      present: int, mode: str) -> None:
+        with self._lock:
+            self.imports += 1
+            self.import_tokens += int(tokens)
+            self.import_bytes += int(nbytes)
+            self.import_blocks_inserted += int(inserted)
+            self.import_blocks_present += int(present)
+            if mode == "paged":
+                self.imports_zero_copy += 1
+            else:
+                self.imports_assembled += 1
+
+    def record_backpressure(self) -> None:
+        with self._lock:
+            self.import_backpressure += 1
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.import_rejected += 1
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "exports": self.exports,
+                "export_bytes": self.export_bytes,
+                "export_tokens": self.export_tokens,
+                "imports": self.imports,
+                "import_bytes": self.import_bytes,
+                "import_tokens": self.import_tokens,
+                "import_blocks": {
+                    "inserted": self.import_blocks_inserted,
+                    "present": self.import_blocks_present,
+                },
+                "imports_zero_copy": self.imports_zero_copy,
+                "imports_assembled": self.imports_assembled,
+                "import_backpressure": self.import_backpressure,
+                "import_rejected": self.import_rejected,
+            }
+
+
+@dataclass
+class DisaggStats:
+    """Router-side counters for phase-split (disaggregated) serving —
+    the ``fleet.disagg`` block on the fleet ``/metrics``.
+
+    ``prefill_dispatches`` counts export legs that completed on a
+    prefill-class replica; ``decode_dispatches`` counts full ships
+    (export + import both landed, so the decode replica serves the
+    request from shipped KV). ``ship_skips`` are requests whose prefix
+    the router already shipped to that decode replica (the per-replica
+    shipped-key LRU). ``fallbacks`` keys every path back to MIXED-mode
+    local prefill by reason — a fallback is a slower request, never a
+    lost one. The byte/latency EWMAs (alpha 0.2) price the transfer the
+    way the page pool prices its backpressure."""
+
+    prefill_dispatches: int = 0
+    decode_dispatches: int = 0
+    ships: int = 0
+    ship_skips: int = 0
+    ship_bytes_total: int = 0
+    ship_bytes_ewma: float = 0.0
+    ship_ms_ewma: float = 0.0
+    import_blocks_inserted: int = 0
+    import_blocks_present: int = 0
+    imports_zero_copy: int = 0
+    imports_assembled: int = 0
+    fallbacks: dict = field(default_factory=dict)  # reason -> n
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def count(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def record_fallback(self, reason: str) -> None:
+        with self._lock:
+            self.fallbacks[str(reason)] = \
+                self.fallbacks.get(str(reason), 0) + 1
+
+    def record_ship(self, *, nbytes: int, ms: float) -> None:
+        with self._lock:
+            self.ships += 1
+            self.ship_bytes_total += int(nbytes)
+            a = 0.2
+            if self.ships == 1:
+                self.ship_bytes_ewma = float(nbytes)
+                self.ship_ms_ewma = float(ms)
+            else:
+                self.ship_bytes_ewma = ((1 - a) * self.ship_bytes_ewma
+                                        + a * float(nbytes))
+                self.ship_ms_ewma = ((1 - a) * self.ship_ms_ewma
+                                     + a * float(ms))
+
+    def record_import_result(self, *, inserted: int, present: int,
+                             mode: str) -> None:
+        with self._lock:
+            self.import_blocks_inserted += int(inserted)
+            self.import_blocks_present += int(present)
+            if mode == "paged":
+                self.imports_zero_copy += 1
+            else:
+                self.imports_assembled += 1
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "prefill_dispatches": self.prefill_dispatches,
+                "decode_dispatches": self.decode_dispatches,
+                "ships": self.ships,
+                "ship_skips": self.ship_skips,
+                "ship_bytes_total": self.ship_bytes_total,
+                "ship_bytes_ewma": round(self.ship_bytes_ewma, 1),
+                "ship_ms_ewma": round(self.ship_ms_ewma, 3),
+                "import_blocks": {
+                    "inserted": self.import_blocks_inserted,
+                    "present": self.import_blocks_present,
+                },
+                "imports_zero_copy": self.imports_zero_copy,
+                "imports_assembled": self.imports_assembled,
+                "fallbacks": dict(self.fallbacks),
+            }
+
+
+@dataclass
 class RouterStats:
     """Counters for the fleet front-door (fleet/router.py), exported on
     the router's ``/metrics`` under ``router``. ``retries`` counts

@@ -40,6 +40,15 @@ functional-cache serving stack:
 Fault injection: ``page_alloc`` is a first-class ``runtime/faults.py``
 site — an injected allocation failure surfaces as a priced shed for that
 row only, never an engine failure.
+
+Pages are also the KV-SHIP unit for disaggregated prefill/decode
+serving (runtime/kvwire.py + fleet/router.py): an export reads a page
+out host-side (``models/llama.py arena_page_slices``, under a held pool
+ref so a concurrent release cannot recycle it mid-read), and an import
+writes each shipped block into its own strictly-allocated page — the
+prefix store allocs the whole ship up front so a full arena surfaces as
+:class:`PagesExhausted` backpressure (the router's fallback-to-mixed
+path) instead of a silently partial cache.
 """
 
 from __future__ import annotations

@@ -107,10 +107,18 @@ class PrefixStore:
     """Radix-tree prefix KV store over a ``LlamaServer``."""
 
     def __init__(self, server: Any, *, block: int = 32,
-                 budget_mb: float = 512.0, pool: Any = None):
+                 budget_mb: float = 512.0, pool: Any = None,
+                 faults: Any = None):
         from lambdipy_tpu.runtime.pagepool import page_width
 
         self.server = server
+        # FaultPlan | None; site "prefix_walk" fires once per cold-walk
+        # chunk dispatch: an injected exception fails the walk OPEN
+        # (route() serves the request unrouted), a delay models the
+        # chunk's prefill device time (bench.py --disagg uses it to put
+        # honest prefill occupancy on a CPU box whose real prefill is
+        # too cheap to measure isolation against)
+        self.faults = faults
         cfg = server.model.cfg
         # PAGED mode (runtime/pagepool.py): a radix block IS an arena
         # page. Nodes hold page ids instead of host-side KV slices, a
@@ -300,6 +308,167 @@ class PrefixStore:
             self.pool.retain(pids)
         return pids, m
 
+    # -- KV export / import (disaggregated prefill/decode) --------------------
+
+    def _present_locked(self, row: list) -> tuple[int, list]:
+        """Longest prefix of a BLOCK-ALIGNED ``row`` whose blocks are
+        all actually present (dense ``kv`` or paged ``page_id`` still
+        live — ``_match_locked`` caps one block short for continuation
+        routing; the ship surface needs the whole head). Returns
+        ``(present token count, path nodes)``."""
+        node, m, path = self._root, 0, []
+        while m < len(row):
+            child = node.children.get(tuple(row[m:m + self.block]))
+            if child is None or (child.page_id is None
+                                 if self.pool is not None
+                                 else child.kv is None):
+                break
+            child.last_used = next(self._clock)
+            path.append(child)
+            node = child
+            m += self.block
+        return m, path
+
+    def _leaf_template(self) -> dict:
+        """name -> (shape, np dtype) of one block slice in THIS server's
+        store layout — what an import frame must match exactly.
+        ``np_dtype`` resolves the ml_dtypes extended set (bfloat16), so
+        a bf16 bundle's template round-trips like its wire frames.
+        Computed once (it is a constant of the server config): the
+        import path must not pay device allocations per frame for
+        static shape metadata."""
+        tmpl = getattr(self, "_leaf_tmpl", None)
+        if tmpl is None:
+            from lambdipy_tpu.models.llama import _empty_cache_entry
+            from lambdipy_tpu.runtime.kvwire import np_dtype
+
+            entry = _empty_cache_entry(self.server.model.cfg, 1,
+                                       self.block)
+            tmpl = {name: (tuple(int(d) for d in val.shape),
+                           np_dtype(val.dtype.name))
+                    for name, val in entry.items()}
+            self._leaf_tmpl = tmpl
+        return tmpl
+
+    def export_blocks(self, tokens):
+        """Serve a KV-export: the whole-block head of ``tokens`` as
+        ``(head, blocks)`` where ``blocks`` is numpy block slices (one
+        list entry per block, per-layer leaf dicts — the wire shape of
+        runtime/kvwire.py). Missing blocks PREFILL here, exactly like a
+        cold route — on a prefill-class replica this call IS the
+        request's prefill phase. Returns None when the prompt has no
+        whole block. A block the tree cannot hold (arena/budget
+        pressure) truncates the export to what is present — the decode
+        side then prefills the tail locally, correct either way."""
+        import numpy as np
+
+        row = [int(t) for t in tokens]
+        cfg = self.server.model.cfg
+        bk = self.block
+        m = min((len(row) // bk) * bk, cfg.max_len - bk)
+        if m <= 0:
+            return None
+        head = row[:m]
+        pids: list = []
+        kvs: list = []
+        for attempt in range(2):
+            with self._lock:
+                self._maybe_flush_stale_locked()
+                present, path = self._present_locked(head)
+                if present >= m or attempt:
+                    if present <= 0:
+                        return None
+                    if self.pool is not None:
+                        # pin under the validating lock: a concurrent
+                        # LRU release-and-reuse must not swap page
+                        # content between the walk and the host read
+                        pids = [n.page_id for n in path]
+                        self.pool.retain(pids)
+                    else:
+                        # python refs keep the slices alive even if the
+                        # budget sweep drops the nodes meanwhile
+                        kvs = [n.kv for n in path]
+                    head = head[:present]
+                    break
+            # prefill the missing blocks through the normal walk (one
+            # retry: a racer eviction mid-walk exports the shorter head)
+            self._extend(head, m)
+        if self.pool is not None:
+            from lambdipy_tpu.models.llama import arena_page_slices
+
+            try:
+                with self.pool.arena_lock:
+                    arena = self.pool.ensure_arena()
+                blocks = [arena_page_slices(arena, pid, self.pool.page)
+                          for pid in pids]
+            finally:
+                self.pool.release(pids)
+        else:
+            blocks = [[{name: np.asarray(val)
+                        for name, val in entry.items()}
+                       for entry in kv] for kv in kvs]
+        return head, blocks
+
+    def import_blocks(self, tokens, blocks) -> dict:
+        """Register shipped whole-block KV under ``tokens`` — a ship
+        arrival is just a radix insert. Dense mode attaches the slices
+        as tree nodes; paged mode writes each new block into its own
+        arena page (``strict`` alloc: :class:`PagesExhausted` propagates
+        as priced backpressure for the router's fallback-to-mixed
+        path). Validates the frame against this server's store layout
+        before any device work — a garbage frame raises ``ValueError``
+        and touches nothing. Idempotent: blocks already present count
+        as ``present`` and are left alone."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        row = [int(t) for t in tokens]
+        bk = self.block
+        cfg = self.server.model.cfg
+        if not row or len(row) % bk or len(row) // bk != len(blocks):
+            raise ValueError(
+                f"import tokens ({len(row)}) must cover exactly "
+                f"{len(blocks)} x {bk}-token blocks")
+        if len(row) > cfg.max_len - bk:
+            raise ValueError(
+                f"shipped prefix of {len(row)} tokens leaves no room "
+                f"to decode in a {cfg.max_len}-token window")
+        template = self._leaf_template()
+        for blk in blocks:
+            if len(blk) != cfg.layers:
+                raise ValueError(
+                    f"frame has {len(blk)} layers, server has "
+                    f"{cfg.layers}")
+            for entry in blk:
+                if set(entry) != set(template):
+                    raise ValueError(
+                        f"frame leaves {sorted(entry)} do not match "
+                        f"store layout {sorted(template)}")
+                for name, val in entry.items():
+                    shape, dt = template[name]
+                    arr = np.asarray(val)
+                    if tuple(arr.shape) != shape or arr.dtype != dt:
+                        raise ValueError(
+                            f"leaf {name!r} is {arr.dtype}{arr.shape}, "
+                            f"server stores {dt}{shape}")
+        with self._lock:
+            self._maybe_flush_stale_locked()
+            present, _ = self._present_locked(row)
+        mode = "paged" if self.pool is not None else "dense"
+        new = blocks[present // bk:]
+        if not new:
+            return {"present": len(blocks), "inserted": 0, "mode": mode}
+        jblocks = [[{name: jnp.asarray(np.asarray(val))
+                     for name, val in entry.items()}
+                    for entry in blk] for blk in new]
+        if self.pool is not None:
+            inserted = self._insert_paged(row, present, jblocks,
+                                          strict=True)
+        else:
+            inserted = self._insert(row, present, jblocks)
+        return {"present": present // bk, "inserted": inserted,
+                "mode": mode}
+
     # -- assembly / extension ------------------------------------------------
 
     def _ensure_assembled(self, row: list, path: list) -> None:
@@ -381,6 +550,11 @@ class PrefixStore:
                     f"prefix walk for key {key[:8]}... owned by another "
                     "thread did not complete within 300s")
 
+    def _walk_fault(self) -> None:
+        """``prefix_walk`` site: once per cold-walk chunk dispatch."""
+        if self.faults is not None:
+            self.faults.check("prefix_walk")
+
     def _walk(self, row: list, matched: int, target: int,
               path: list) -> None:
         import jax.numpy as jnp
@@ -400,6 +574,7 @@ class PrefixStore:
                 fw = self.walk_chunk if target >= self.walk_chunk else bk
                 pf = server._prefix_first_fn(fw, cfg.max_len)
                 prompt_op, _ = server._pad_rows([row[:fw]], [fw], 1, fw)
+                self._walk_fault()
                 cache = pf(server.params, prompt_op, jnp.int32(fw))
                 pos = fw
             elif self.pool is not None:
@@ -440,6 +615,7 @@ class PrefixStore:
             ext = server._prefix_ext_fn(bk)
             ext_wide = server._prefix_ext_fn(wk) if wk > bk else None
             while pos < target:
+                self._walk_fault()
                 if (ext_wide is not None and target - pos >= wk
                         and pos + wk <= cfg.max_len):
                     chunk_op, _ = server._pad_rows(
@@ -466,9 +642,11 @@ class PrefixStore:
                                target)
         self._insert(row, matched, new_blocks)
 
-    def _insert(self, row: list, start: int, new_blocks: list) -> None:
+    def _insert(self, row: list, start: int, new_blocks: list) -> int:
         """Attach the freshly computed block slices under the matched
-        path (idempotent against racers), then sweep the budget."""
+        path (idempotent against racers), then sweep the budget.
+        Returns blocks actually attached (a racer may have won some)."""
+        attached = 0
         with self._lock:
             # re-walk from the root: a racer may have restructured the
             # path (or inserted some of these very blocks) meanwhile
@@ -486,13 +664,15 @@ class PrefixStore:
                     child = _Node(node, tok_key, kv, _slices_bytes(kv))
                     node.children[tok_key] = child
                     self.stats_counters.record_insert(1, child.nbytes)
+                    attached += 1
                 child.last_used = next(self._clock)
                 node = child
                 m += self.block
             self._evict_locked()
+        return attached
 
-    def _insert_paged(self, row: list, start: int,
-                      new_blocks: list) -> None:
+    def _insert_paged(self, row: list, start: int, new_blocks: list,
+                      *, strict: bool = False) -> int:
         """Paged-mode insertion: write each fresh block slice into its
         own arena page (``_page_write_fn``) and attach page-carrying
         nodes under the matched path. The page writes — including the
@@ -502,7 +682,11 @@ class PrefixStore:
         device work. Out-of-pages asks the pool's reclaim hook (this
         store's cold unshared leaves) via ``alloc``; a genuinely full
         arena just caches fewer blocks — fail open, the request already
-        has its KV in the walk cache."""
+        has its KV in the walk cache. ``strict`` (the KV-IMPORT path)
+        instead allocates every page up front and PROPAGATES
+        :class:`PagesExhausted`: a ship the arena cannot hold must
+        surface as priced backpressure to the router, not silently
+        cache nothing. Returns blocks actually attached."""
         import jax.numpy as jnp
 
         from lambdipy_tpu.runtime.pagepool import PagesExhausted
@@ -511,26 +695,43 @@ class PrefixStore:
         write = server._page_write_fn(pool.n_pages, pool.page)
         gen = pool.arena_generation
         staged: list[int] = []
-        for blk in new_blocks:
-            try:
-                pid = pool.alloc(1, tokens=bk, record_shed=False)[0]
-            except PagesExhausted:
-                break  # cache less; `sheds` meters admissions only
-            except Exception as e:  # noqa: BLE001 — injected fault etc.
-                log.error("prefix page alloc failed (caching less): %s",
-                          e)
-                break
-            with pool.arena_lock:
-                arena = pool.ensure_arena()
-                pool.arena = write(arena, jnp.int32(pid), blk)
-            staged.append(pid)
+        pre: list[int] = []
+        if strict:
+            # one all-or-nothing alloc: record_shed=False keeps a ship
+            # refusal out of the pool's admission-shed counter (the
+            # router's fallback counter owns this failure mode)
+            pre = pool.alloc(len(new_blocks), tokens=len(new_blocks) * bk,
+                             record_shed=False)
+        try:
+            for i, blk in enumerate(new_blocks):
+                if strict:
+                    pid = pre[i]
+                else:
+                    try:
+                        pid = pool.alloc(1, tokens=bk,
+                                         record_shed=False)[0]
+                    except PagesExhausted:
+                        break  # cache less; `sheds` meters admissions
+                    except Exception as e:  # noqa: BLE001 — injected
+                        log.error("prefix page alloc failed (caching "
+                                  "less): %s", e)
+                        break
+                with pool.arena_lock:
+                    arena = pool.ensure_arena()
+                    pool.arena = write(arena, jnp.int32(pid), blk)
+                staged.append(pid)
+        except Exception:
+            # a failed page write must not leak its un-staged pages
+            pool.release([p for p in pre if p not in staged])
+            pool.release(staged)
+            raise
         attached: set[int] = set()
         with self._lock:
             self._maybe_flush_stale_locked()
             if pool.arena_generation != gen:
                 # the arena reset mid-stage: the staged content is gone
                 pool.release(staged)
-                return
+                return 0
             node, m = self._root, 0
             while m < start + len(staged) * bk:
                 tok_key = tuple(row[m:m + bk])
@@ -556,6 +757,7 @@ class PrefixStore:
             # a racer already held those nodes (its pages serve), or the
             # base path vanished: our staged duplicates return
             pool.release(leftovers)
+        return len(attached)
 
     def reclaim_pages(self, n: int) -> int:
         """Pool out-of-pages hook: release up to ``n`` cold UNSHARED

@@ -410,6 +410,12 @@ class BundleServer:
                 if self.path == "/v1/completions":
                     self._openai_completions()
                     return
+                if self.path == "/v1/kv/export":
+                    self._kv_export()
+                    return
+                if self.path == "/v1/kv/import":
+                    self._kv_import()
+                    return
                 if self.path == "/profile":
                     req = self._read_json()
                     if req is None:
@@ -580,6 +586,125 @@ class BundleServer:
                     self._send(200, _internal_to_openai(internal, result))
                 finally:
                     self._end_invoke(ticket, t_start)
+
+            def _kv_export(self):
+                """Disaggregated-serving export: the request's whole-
+                block prompt head leaves as a binary KV frame
+                (runtime/kvwire.py). Missing blocks prefill here — on a
+                prefill-class replica this call IS the request's
+                prefill phase, so it passes the same admission gate as
+                an invoke (the estimator prices the suffix via the
+                prefix probe, exactly like a generate)."""
+                fn = getattr(server_self.boot.state, "kv_export_fn", None)
+                request = self._read_json()
+                if request is None:
+                    server_self.stats.record_error()
+                    return
+                if fn is None:
+                    self._send(404, {"ok": False, "error":
+                                     "no KV export surface (prefix "
+                                     "cache off or unsupported handler)"})
+                    return
+                ticket = self._begin_invoke(request)
+                if ticket is None:
+                    return
+                t0 = time.monotonic()
+                try:
+                    try:
+                        out = fn(request)
+                    except RequestCancelled as e:
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "cancelled", cls)
+                        self._send_shed(Shed(503, str(e), 1.0))
+                        return
+                    except PagesExhausted as e:
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "kv_pages", cls)
+                        self._send_shed(
+                            Shed(503, "kv_pages", e.retry_after_s))
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        server_self.stats.record_error()
+                        log_event(log, "kv export failed", error=str(e),
+                                  kind=type(e).__name__)
+                        self._send(500, {"ok": False, "error": str(e),
+                                         "kind": type(e).__name__})
+                        return
+                    if isinstance(out, dict):  # handler-level refusal
+                        self._send(400, out)
+                        return
+                    server_self.stats.record(
+                        (time.monotonic() - t0) * 1e3)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    try:
+                        self.wfile.write(out)
+                    except OSError:
+                        self.close_connection = True
+                finally:
+                    self._end_invoke(ticket, t0)
+
+            def _kv_import(self):
+                """Disaggregated-serving import: a shipped KV frame
+                becomes a radix insert. A full page arena answers the
+                priced-shed 503 (reason ``kv_import``) so the router
+                falls back to mixed-mode local prefill; a malformed
+                frame is a 400 and touches nothing."""
+                fn = getattr(server_self.boot.state, "kv_import_fn", None)
+                # consume the body before any early reply: on keep-alive
+                # the unread frame bytes would parse as the next request
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    length = 0
+                data = self.rfile.read(length) if length > 0 else b""
+                if fn is None:
+                    self._send(404, {"ok": False, "error":
+                                     "no KV import surface (prefix "
+                                     "cache off or unsupported handler)"})
+                    return
+                ticket = self._begin_invoke(None)
+                if ticket is None:
+                    return
+                t0 = time.monotonic()
+                try:
+                    try:
+                        out = fn(data)
+                    except PagesExhausted as e:
+                        # decode-side import backpressure: same priced-
+                        # shed wire shape as every other 503, distinct
+                        # reason so operators can tell a full arena
+                        # from a full queue
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "kv_import", cls)
+                        self._send_shed(
+                            Shed(503, "kv_import", e.retry_after_s))
+                        return
+                    except ValueError as e:
+                        self._send(400, {"ok": False,
+                                         "error": f"bad KV frame: {e}"})
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        server_self.stats.record_error()
+                        log_event(log, "kv import failed", error=str(e),
+                                  kind=type(e).__name__)
+                        self._send(500, {"ok": False, "error": str(e),
+                                         "kind": type(e).__name__})
+                        return
+                    server_self.stats.record(
+                        (time.monotonic() - t0) * 1e3)
+                    self._send(200, out)
+                finally:
+                    self._end_invoke(ticket, t0)
 
             def _write_frame(self, body: bytes) -> bool:
                 """One chunked-transfer frame; False = client went away

@@ -46,6 +46,13 @@
 # CPU host devices: bitwise tp=2-vs-tp=1 parity across the same matrix,
 # plus the per-device KV/param HBM halving gate from batching.mesh).
 #
+# Phase 12 is the DISAGGREGATED-SERVING sweep (bench.py --disagg,
+# subprocess replicas): bitwise split-fleet-vs-direct parity (greedy +
+# seeded-sampled, dense + paged KV, real KV ships observed), decode
+# tok/s under a concurrent cold-prefill burst >= 1.2x the mixed fleet
+# at equal replica count, and an injected kv_ship failure completing
+# the whole burst bitwise with zero client-visible errors.
+#
 # Every phase prints its wall-clock so the budget breakdown is visible
 # in the log (ROADMAP open item: phase 2 runs close to its 870 s cap).
 
@@ -216,4 +223,19 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 11"
+
+# Phase 12: disaggregated prefill/decode — bench.py --disagg boots
+# subprocess replica pairs (dense, then paged) behind the phase-split
+# router and exits nonzero if split-fleet outputs diverge bitwise from
+# direct (greedy + seeded-sampled), if no KV ship actually lands (or a
+# paged import is not a zero-copy page insert), if split-fleet decode
+# tok/s under the cold-prefill burst fails the 1.2x gate vs the mixed
+# fleet, or if an injected kv_ship failure costs any request.
+phase_begin "phase 12: disaggregated serving sweep (bench.py --disagg)"
+if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python bench.py --disagg; then
+    echo "FATAL: bench.py --disagg sweep failed" >&2
+    exit 1
+fi
+phase_end "phase 12"
 exit 0

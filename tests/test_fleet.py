@@ -132,7 +132,11 @@ class StubReplica:
                                      "hit_tokens": 0},
                     "spec": {"sp_standdown": 0,
                              "sp_standdown_reasons": {}}}
+        self.cfg["kv_shed"] = False   # /v1/kv/import answers 503
+        self.cfg["kv_frame"] = b"LKV1-stub-frame"  # /v1/kv/export body
         self.invokes = 0
+        self.exports = 0
+        self.imports = []  # raw frames received on /v1/kv/import
         self.bodies = []  # (path, parsed body) of every POST received
         stub = self
 
@@ -174,8 +178,39 @@ class StubReplica:
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
+                raw = self.rfile.read(length)
+                if self.path == "/v1/kv/import":
+                    # binary frame, not JSON; scriptable backpressure
+                    if stub.cfg["kv_shed"]:
+                        ra = stub.cfg["retry_after"]
+                        self._send(503, {"ok": False, "shed": True,
+                                         "reason": "kv_import",
+                                         "retry_after_s": float(ra)},
+                                   {"Retry-After": str(ra)})
+                        return
+                    stub.imports.append(raw)
+                    self._send(200, {"ok": True, "inserted": 2,
+                                     "present": 0, "mode": "dense"})
+                    return
+                body = json.loads(raw or b"{}")
                 stub.bodies.append((self.path, body))
+                if self.path == "/v1/kv/export":
+                    if stub.cfg["shed"] or stub.cfg["draining"]:
+                        ra = stub.cfg["retry_after"]
+                        self._send(503, {"ok": False, "shed": True,
+                                         "reason": "draining",
+                                         "retry_after_s": float(ra)},
+                                   {"Retry-After": str(ra)})
+                        return
+                    stub.exports += 1
+                    frame = stub.cfg["kv_frame"]
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(frame)))
+                    self.end_headers()
+                    self.wfile.write(frame)
+                    return
                 if stub.cfg["delay_s"]:
                     time.sleep(stub.cfg["delay_s"])
                 if stub.cfg["shed"] or stub.cfg["draining"]:

@@ -692,3 +692,288 @@ def test_router_healthz_reports_spill_depth(stub_pair):
         assert h["ok"] and h["spill_depth"] == 0
     finally:
         router.stop()
+
+
+# -- disaggregated prefill/decode: classes, ships, chaos ---------------------
+
+
+from lambdipy_tpu.fleet import (  # noqa: E402 — section-local imports
+    DECODE,
+    MIXED,
+    PREFILL,
+    parse_attach_spec,
+)
+
+
+def test_parse_attach_spec_grammar():
+    assert parse_attach_spec("a=http://h:8080") == \
+        ("a", "http://h:8080", MIXED)
+    assert parse_attach_spec("p0=http://h:8080:prefill") == \
+        ("p0", "http://h:8080", PREFILL)
+    assert parse_attach_spec("d0=https://h:decode") == \
+        ("d0", "https://h", DECODE)
+    assert parse_attach_spec("m=http://h:9090:mixed") == \
+        ("m", "http://h:9090", MIXED)
+    with pytest.raises(FleetError, match="unknown replica class"):
+        parse_attach_spec("x=http://h:8080:prefil")
+    with pytest.raises(FleetError, match="NAME=URL"):
+        parse_attach_spec("http://h:8080")
+    with pytest.raises(FleetError, match="NAME=URL"):
+        parse_attach_spec("x=ftp://h")
+
+
+@pytest.fixture()
+def disagg_pair():
+    """One decode-class + one prefill-class stub behind a router."""
+    dec, pre = StubReplica("dec"), StubReplica("pre")
+    pool = ReplicaPool(probe_interval=5.0, fail_threshold=1,
+                       readmit_passes=2, probe_timeout=2.0)
+    pool.attach("dec", dec.url, role=DECODE)
+    pool.attach("pre", pre.url, role=PREFILL)
+    pool.probe_all()
+    yield dec, pre, pool
+    pool.close()
+    for s in (dec, pre):
+        try:
+            s.kill()
+        except Exception:
+            pass
+
+
+def _router(pool, **kw):
+    kw.setdefault("affinity_on", True)
+    kw.setdefault("block", 4)
+    return FleetRouter(pool, **kw).start_background()
+
+
+def test_phase_split_ships_then_forwards(disagg_pair):
+    """A cold token request exports on the prefill replica, imports on
+    the decode replica, and the request itself only ever touches the
+    decode replica; a repeat request skips the ship (dedup LRU)."""
+    dec, pre, pool = disagg_pair
+    router = _router(pool)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        row = list(range(1, 13))
+        out = _post(f"{base}/invoke", {"tokens": row, "max_new_tokens": 2})
+        assert out["ok"] and out["replica"] == "dec"
+        assert pre.exports == 1 and len(dec.imports) == 1
+        assert dec.imports[0] == pre.cfg["kv_frame"]
+        assert pre.invokes == 0  # prefill class never serves decode
+        _post(f"{base}/invoke", {"tokens": row, "max_new_tokens": 2})
+        assert pre.exports == 1  # second ship deduped
+        rep = router.disagg.report()
+        assert rep["ships"] == 1 and rep["ship_skips"] == 1
+        assert rep["prefill_dispatches"] == 1
+        assert rep["decode_dispatches"] == 1
+        assert rep["ship_bytes_total"] == len(pre.cfg["kv_frame"])
+        assert rep["ship_ms_ewma"] > 0
+        assert rep["import_blocks"]["inserted"] == 2
+        m = _get(f"{base}/metrics")
+        assert m["fleet"]["disagg"]["classes"] == \
+            {"decode": 1, "prefill": 1}
+        h = _get(f"{base}/healthz")
+        assert h["classes"] == {"decode": 1, "prefill": 1}
+    finally:
+        router.stop()
+
+
+def test_string_prompt_falls_back_to_mixed(disagg_pair):
+    """The router never tokenizes: a string prompt cannot key a KV
+    frame, so it serves mixed-mode with the fallback counted."""
+    dec, pre, pool = disagg_pair
+    router = _router(pool)
+    try:
+        out = _post(f"http://127.0.0.1:{router.port}/v1/completions",
+                    {"prompt": "a" * 64, "max_tokens": 2})
+        assert out["ok"] is True  # delivered (stub echoes /invoke shape)
+        assert pre.exports == 0
+        assert router.disagg.report()["fallbacks"].get("no_token_head") \
+            == 1
+    finally:
+        router.stop()
+
+
+def test_ship_drop_falls_back_bitwise_and_counted(disagg_pair):
+    """Injected kv_ship failure: the request still delivers (identical
+    payload — the stub echoes the tokens), the fallback is counted, and
+    the prefill replica is NOT ejected (the fault fired router-side,
+    before any connection)."""
+    dec, pre, pool = disagg_pair
+    plan = FaultPlan.from_spec("kv_ship:exception@seg=1,n=2")
+    router = _router(pool, faults=plan)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        rows = [list(range(10 * i, 10 * i + 8)) for i in range(1, 4)]
+        outs = [_post(f"{base}/invoke", {"tokens": r}) for r in rows]
+        assert all(o["ok"] and o["replica"] == "dec" for o in outs)
+        # delivery is bitwise what a shipless forward returns
+        assert [o["echo"] for o in outs] == rows
+        rep = router.disagg.report()
+        assert rep["fallbacks"]["ship_fault"] == 2
+        assert rep["ships"] == 1  # the third request shipped fine
+        assert pool.replicas["pre"].state == READY
+        assert router.stats.report()["errors"] == 0
+    finally:
+        router.stop()
+
+
+def test_ship_latency_delivers_and_prices(disagg_pair):
+    """An injected kv_ship delay slows the ship, not the contract: the
+    ship lands, the latency EWMA reflects it."""
+    dec, pre, pool = disagg_pair
+    plan = FaultPlan.from_spec("kv_ship:delay@ms=150,n=1")
+    router = _router(pool, faults=plan)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        out = _post(f"{base}/invoke", {"tokens": list(range(1, 9))})
+        assert out["ok"]
+        rep = router.disagg.report()
+        assert rep["ships"] == 1 and rep["fallbacks"] == {}
+        assert rep["ship_ms_ewma"] >= 150
+    finally:
+        router.stop()
+
+
+def test_import_backpressure_falls_back(disagg_pair):
+    """A decode replica shedding its import (full page arena) costs the
+    ship, never the request — and the shipped-key LRU does NOT mark the
+    prefix warm, so the next request re-attempts the ship."""
+    dec, pre, pool = disagg_pair
+    dec.cfg["kv_shed"] = True
+    router = _router(pool)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        row = list(range(1, 9))
+        out = _post(f"{base}/invoke", {"tokens": row})
+        assert out["ok"] and out["replica"] == "dec"
+        rep = router.disagg.report()
+        assert rep["fallbacks"]["import_backpressure"] == 1
+        assert rep["prefill_dispatches"] == 1  # export leg did land
+        assert rep["decode_dispatches"] == 0
+        dec.cfg["kv_shed"] = False
+        out = _post(f"{base}/invoke", {"tokens": row})
+        assert router.disagg.report()["decode_dispatches"] == 1
+    finally:
+        router.stop()
+
+
+def test_dead_prefill_class_degrades_to_mixed(disagg_pair):
+    """Every prefill replica ejected: requests serve mixed-mode on the
+    decode class, counted by reason — never an error."""
+    dec, pre, pool = disagg_pair
+    pre.kill()
+    pool.note_failure(pool.replicas["pre"])
+    assert pool.replicas["pre"].state == EJECTED
+    router = _router(pool)
+    try:
+        out = _post(f"http://127.0.0.1:{router.port}/invoke",
+                    {"tokens": list(range(1, 9))})
+        assert out["ok"] and out["replica"] == "dec"
+        rep = router.disagg.report()
+        assert rep["fallbacks"]["no_prefill_replica"] == 1
+        assert rep["ships"] == 0
+    finally:
+        router.stop()
+
+
+def test_no_decode_class_degrades_to_prefill_mixed():
+    """The inverse hole: only prefill-class replicas routable. The
+    router must still deliver (a prefill replica is a full bundle
+    server) rather than brown out — counted, never silent."""
+    pre = StubReplica("pre")
+    pool = ReplicaPool(probe_interval=5.0, probe_timeout=2.0)
+    pool.attach("pre", pre.url, role=PREFILL)
+    pool.probe_all()
+    router = _router(pool)
+    try:
+        out = _post(f"http://127.0.0.1:{router.port}/invoke",
+                    {"tokens": list(range(1, 9))})
+        assert out["ok"] and out["replica"] == "pre"
+        assert router.disagg.report()["fallbacks"][
+            "no_decode_replica"] >= 1
+    finally:
+        router.stop()
+        pool.close()
+        pre.kill()
+
+
+def test_readmission_clears_shipped_keys(disagg_pair):
+    """An ejected decode replica's radix cache died with its worker: on
+    readmission the router must forget what it shipped there and ship
+    again."""
+    dec, pre, pool = disagg_pair
+    router = _router(pool)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        row = list(range(1, 13))
+        _post(f"{base}/invoke", {"tokens": row})
+        assert pre.exports == 1
+        # eject then readmit the decode replica
+        r = pool.replicas["dec"]
+        pool.note_failure(r)
+        assert r.state == EJECTED
+        for _ in range(2):
+            pool.probe_one(r)
+        assert r.state == READY
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                "dec" in router._shipped:
+            time.sleep(0.02)
+        _post(f"{base}/invoke", {"tokens": row})
+        assert pre.exports == 2  # re-shipped after the cache died
+    finally:
+        router.stop()
+
+
+def test_stream_ships_before_first_byte(disagg_pair):
+    """Streams ride the phase split too: the ship happens before the
+    stream opens, so the decode replica serves the whole stream from
+    shipped KV."""
+    dec, pre, pool = disagg_pair
+    router = _router(pool)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        req = urllib.request.Request(
+            f"{base}/invoke",
+            data=json.dumps({"tokens": list(range(1, 13)),
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            lines = [json.loads(ln) for ln in resp if ln.strip()]
+        assert lines and lines[-1].get("done")
+        assert pre.exports == 1 and len(dec.imports) == 1
+        assert router.disagg.report()["decode_dispatches"] == 1
+    finally:
+        router.stop()
+
+
+def test_parse_attach_spec_keeps_odd_urls():
+    """The pre-class grammar accepted any http URL: a portless IPv6
+    literal or a path-bearing URL must still attach (mixed), only an
+    alphabetic non-class suffix raises."""
+    assert parse_attach_spec("a=http://[::1]") == \
+        ("a", "http://[::1]", MIXED)
+    assert parse_attach_spec("a=http://h:8080/base") == \
+        ("a", "http://h:8080/base", MIXED)
+
+
+def test_ship_skips_breaker_blocked_decode_target(disagg_pair):
+    """An open decode-replica breaker shields it from ships too — the
+    ship must target the replica the forward will actually pick."""
+    dec, pre, pool = disagg_pair
+    router = _router(pool, breaker_fails=1, breaker_open_s=30.0)
+    try:
+        # trip dec's breaker (a forward connection failure)
+        b = router._breaker(pool.replicas["dec"])
+        b.record_failure()
+        assert router._breaker_blocked(pool.replicas["dec"])
+        out = _post(f"http://127.0.0.1:{router.port}/invoke",
+                    {"tokens": list(range(1, 13))})
+        # the only decode-capable replica is breaker-blocked: no ship
+        # (and the request degraded per the normal pick rules)
+        assert pre.exports == 0 and len(dec.imports) == 0
+        assert router.disagg.report()["fallbacks"][
+            "no_decode_replica"] >= 1
+    finally:
+        router.stop()

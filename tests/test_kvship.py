@@ -1,0 +1,491 @@
+"""KV-block shipping for disaggregated prefill/decode serving: wire
+framing (runtime/kvwire.py), the prefix store's export/import surface,
+and the replica HTTP endpoints.
+
+The acceptance bar mirrors every serve-path PR: KV that crosses the
+wire must read back BITWISE — export→import round trips across
+dense/paged stores and float/int8-with-scales layouts produce outputs
+identical to the unshipped path, garbage frames are rejected before
+they touch the radix tree, and a full page arena surfaces as priced
+backpressure instead of silent cache loss."""
+
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models.llama import init_page_arena, page_kv_bytes
+from lambdipy_tpu.runtime.kvwire import decode_frame, encode_frame
+from lambdipy_tpu.runtime.pagepool import (
+    PagePool,
+    PagesExhausted,
+    page_width,
+)
+from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+BLOCK = 16
+
+
+@pytest.fixture(scope="module")
+def tiny_server():
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    return adapter.make_server(params)
+
+
+@pytest.fixture(scope="module")
+def int8_server():
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-tiny").build(
+        extra={"kv_quant": "int8"})
+    params = adapter.init_params(seed=0)
+    return adapter.make_server(params)
+
+
+def mk_pool(server, *, n_windows=4, extra_pages=0, block=BLOCK):
+    cfg = server.model.cfg
+    page = page_width(cfg.max_len, block)
+    n_pages = n_windows * (cfg.max_len // page) + 1 + extra_pages
+    return PagePool(n_pages=n_pages, page=page,
+                    page_bytes=page_kv_bytes(cfg, page),
+                    make_arena=lambda: init_page_arena(cfg, n_pages,
+                                                       page))
+
+
+def clear_prefix_lru(server):
+    """Stores in these tests share one server: drop the server-level
+    assembled-prefix LRU so the importing store must serve from its OWN
+    tree, not from the exporter's registered entry."""
+    with server._prefix_lock:
+        server._prefixes.clear()
+
+
+# -- wire format --------------------------------------------------------------
+
+
+def _fake_blocks(n_blocks, layers=2, dtype=np.float32, int8=False):
+    rng = np.random.default_rng(0)
+    out = []
+    for b in range(n_blocks):
+        blk = []
+        for layer in range(layers):
+            if int8:
+                blk.append({
+                    "k_int8": rng.integers(-127, 127, (1, BLOCK, 2, 4),
+                                           dtype=np.int8),
+                    "k_scale": rng.random((1, BLOCK, 2, 1),
+                                          dtype=np.float32),
+                    "v_int8": rng.integers(-127, 127, (1, BLOCK, 2, 4),
+                                           dtype=np.int8),
+                    "v_scale": rng.random((1, BLOCK, 2, 1),
+                                          dtype=np.float32),
+                })
+            else:
+                blk.append({
+                    "k": rng.random((1, BLOCK, 2, 4)).astype(dtype),
+                    "v": rng.random((1, BLOCK, 2, 4)).astype(dtype),
+                })
+        out.append(blk)
+    return out
+
+
+@pytest.mark.parametrize("int8", [False, True])
+def test_wire_roundtrip_bitwise(int8):
+    blocks = _fake_blocks(3, int8=int8)
+    tokens = list(range(3 * BLOCK))
+    frame = encode_frame(tokens, BLOCK, blocks)
+    t2, bk2, out = decode_frame(frame)
+    assert t2 == tokens and bk2 == BLOCK and len(out) == 3
+    for b1, b2 in zip(blocks, out):
+        for e1, e2 in zip(b1, b2):
+            assert set(e1) == set(e2)
+            for name in e1:
+                assert e1[name].dtype == e2[name].dtype
+                np.testing.assert_array_equal(e1[name], e2[name])
+
+
+def test_wire_roundtrip_bfloat16():
+    """bf16 bundles ship their KV bitwise through the ml_dtypes name
+    resolution, not a float32 detour."""
+    import ml_dtypes
+
+    blocks = _fake_blocks(1, dtype=ml_dtypes.bfloat16)
+    frame = encode_frame(list(range(BLOCK)), BLOCK, blocks)
+    _, _, out = decode_frame(frame)
+    assert out[0][0]["k"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        out[0][0]["k"].view(np.uint16),
+        np.asarray(blocks[0][0]["k"]).view(np.uint16))
+
+
+def test_wire_rejects_garbage():
+    blocks = _fake_blocks(2)
+    frame = encode_frame(list(range(2 * BLOCK)), BLOCK, blocks)
+    with pytest.raises(ValueError, match="magic"):
+        decode_frame(b"NOPE" + frame[4:])
+    with pytest.raises(ValueError, match="truncated|body"):
+        decode_frame(frame[:-10])
+    with pytest.raises(ValueError, match="body"):
+        decode_frame(frame + b"\x00" * 8)
+    with pytest.raises(ValueError):
+        decode_frame(b"")
+    with pytest.raises(ValueError, match="header length"):
+        decode_frame(b"LKV1" + b"\xff\xff\xff\xff" + b"x" * 32)
+    # a header that lies about its leaves must not survive validation
+    import json as _json
+    import struct as _struct
+    hlen = _struct.unpack_from("<I", frame, 4)[0]
+    header = _json.loads(frame[8:8 + hlen])
+    header["leaves"][0][0] = "not_a_leaf"
+    hb = _json.dumps(header).encode()
+    with pytest.raises(ValueError, match="leaf names"):
+        decode_frame(b"LKV1" + _struct.pack("<I", len(hb)) + hb
+                     + frame[8 + hlen:])
+    # tokens not covering the blocks
+    header = _json.loads(frame[8:8 + hlen])
+    header["tokens"] = header["tokens"][:-1]
+    hb = _json.dumps(header).encode()
+    with pytest.raises(ValueError, match="tokens"):
+        decode_frame(b"LKV1" + _struct.pack("<I", len(hb)) + hb
+                     + frame[8 + hlen:])
+
+
+def test_encode_validates_coverage():
+    with pytest.raises(ValueError, match="cover"):
+        encode_frame(list(range(BLOCK + 1)), BLOCK, _fake_blocks(1))
+    with pytest.raises(ValueError, match="nothing"):
+        encode_frame([], BLOCK, [])
+
+
+# -- store-level export / import ---------------------------------------------
+
+
+def test_dense_ship_parity_greedy_and_sampled(tiny_server):
+    """export→wire→import between two dense stores: the importing
+    replica's routed output is BITWISE the unrouted output, greedy and
+    seeded-sampled."""
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    imp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    row = list(range(3, 45))  # 42 tokens -> 32-token head
+    for kw in ({}, dict(temperature=0.9, seed=11, top_k=5, top_p=0.9)):
+        off = tiny_server.generate(row, max_new_tokens=8, **kw)
+        head, blocks = exp.export_blocks(row)
+        assert len(head) == 32
+        tokens, bk, wire = decode_frame(
+            encode_frame(head, exp.block, blocks))
+        clear_prefix_lru(tiny_server)
+        res = imp.import_blocks(tokens, wire)
+        assert res["mode"] == "dense"
+        m = imp.route(row)
+        assert m == 32
+        on = tiny_server.generate(row[m:], prefix=row[:m],
+                                  max_new_tokens=8, **kw)
+        np.testing.assert_array_equal(on, off, err_msg=str(kw))
+    # second import of the same frame is an idempotent no-op
+    head, blocks = exp.export_blocks(row)
+    res = imp.import_blocks(*decode_frame(
+        encode_frame(head, exp.block, blocks))[0::2])
+    assert res == {"present": 2, "inserted": 0, "mode": "dense"}
+
+
+def test_paged_import_is_zero_copy(tiny_server):
+    """A ship arrival on a paged decode replica lands in arena pages:
+    the hit is an acquire_pages refcount bump — engine output bitwise,
+    zero assembly bytes."""
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    pool = mk_pool(tiny_server)
+    imp = PrefixStore(tiny_server, block=BLOCK, budget_mb=64, pool=pool)
+    row = list(range(5, 47))
+    off = tiny_server.generate(row, max_new_tokens=8)
+    head, blocks = exp.export_blocks(row)
+    clear_prefix_lru(tiny_server)
+    res = imp.import_blocks(*decode_frame(
+        encode_frame(head, exp.block, blocks))[0::2])
+    assert res["mode"] == "paged" and res["inserted"] == 2
+    got = imp.acquire_pages(head)
+    assert got is not None and got[1] == 32
+    pool.release(got[0])
+    eng = ContinuousBatcher(tiny_server, slots=4, segment=8,
+                            page_pool=pool)
+    eng.prefix_pages_fn = imp.acquire_pages
+    m = imp.route(row)
+    assert m == 32
+    on = eng.generate(row[m:], max_new_tokens=8, prefix=row[:m])
+    np.testing.assert_array_equal(np.asarray(on), np.asarray(off))
+    # the paged consume path never assembled a full-window cache
+    assert imp.stats()["assembly_bytes_peak"] == 0
+    pool.check_invariants()
+
+
+def test_paged_export_to_dense_import(tiny_server):
+    """The wire is mode-agnostic: pages exported from a paged store
+    import into a dense store bitwise."""
+    pool = mk_pool(tiny_server)
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=64, pool=pool)
+    imp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    row = list(range(9, 51))
+    off = tiny_server.generate(row, max_new_tokens=8)
+    head, blocks = exp.export_blocks(row)
+    assert len(head) == 32 and len(blocks) == 2
+    clear_prefix_lru(tiny_server)
+    imp.import_blocks(*decode_frame(
+        encode_frame(head, exp.block, blocks))[0::2])
+    m = imp.route(row)
+    on = tiny_server.generate(row[m:], prefix=row[:m], max_new_tokens=8)
+    np.testing.assert_array_equal(on, off)
+    pool.check_invariants()
+
+
+def test_int8_ship_roundtrip(int8_server):
+    """int8 KV ships as int8 + f32 scales (first-class wire leaves) and
+    the imported replica's routed output is bitwise the exporter's
+    routed output — the stored bytes crossed unchanged."""
+    exp = PrefixStore(int8_server, block=BLOCK, budget_mb=8)
+    imp = PrefixStore(int8_server, block=BLOCK, budget_mb=8)
+    row = list(range(4, 46))
+    head, blocks = exp.export_blocks(row)
+    assert {"k_int8", "k_scale", "v_int8", "v_scale"} == set(blocks[0][0])
+    m = exp.route(row)
+    routed_a = int8_server.generate(row[m:], prefix=row[:m],
+                                    max_new_tokens=8)
+    clear_prefix_lru(int8_server)
+    imp.import_blocks(*decode_frame(
+        encode_frame(head, exp.block, blocks))[0::2])
+    m2 = imp.route(row)
+    assert m2 == m
+    routed_b = int8_server.generate(row[m2:], prefix=row[:m2],
+                                    max_new_tokens=8)
+    np.testing.assert_array_equal(routed_b, routed_a)
+
+
+def test_partial_block_tail_prefills_locally(tiny_server):
+    """A prompt with a sub-block tail ships only its whole blocks; the
+    decode side prefills the tail itself — outputs still bitwise."""
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    imp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    row = list(range(7, 50))  # 43 tokens: head 32, 11-token tail
+    off = tiny_server.generate(row, max_new_tokens=8)
+    head, blocks = exp.export_blocks(row)
+    assert len(head) == 32
+    clear_prefix_lru(tiny_server)
+    imp.import_blocks(*decode_frame(
+        encode_frame(head, exp.block, blocks))[0::2])
+    m = imp.route(row)
+    assert m == 32  # the tail stays suffix
+    on = tiny_server.generate(row[m:], prefix=row[:m], max_new_tokens=8)
+    np.testing.assert_array_equal(on, off)
+
+
+def test_export_sub_block_returns_none(tiny_server):
+    store = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    assert store.export_blocks(list(range(BLOCK - 1))) is None
+
+
+def test_prefix_walk_fault_fails_open_bitwise(tiny_server):
+    """The prefix_walk chaos site: an injected walk exception must cost
+    only the cache (route returns 0, the request serves unrouted and
+    bitwise); a delay fires once per chunk dispatch."""
+    from lambdipy_tpu.runtime.faults import FaultPlan
+
+    plan = FaultPlan.from_spec("prefix_walk:exception@seg=1,n=1")
+    store = PrefixStore(tiny_server, block=BLOCK, budget_mb=8,
+                        faults=plan)
+    row = list(range(6, 48))
+    off = tiny_server.generate(row, max_new_tokens=8)
+    assert store.route(row) == 0  # walk failed -> fail open
+    on = tiny_server.generate(row, max_new_tokens=8)
+    np.testing.assert_array_equal(on, off)
+    # the rule is spent: the next route walks and caches normally
+    assert store.route(row) == 32
+    # delay kind: one firing per chunk dispatch, deterministic count
+    plan2 = FaultPlan.from_spec("prefix_walk:delay@ms=1,n=inf")
+    store2 = PrefixStore(tiny_server, block=BLOCK, budget_mb=8,
+                         faults=plan2)
+    row2 = list(range(60, 60 + 33))  # 32-token head, cold
+    assert store2.route(row2) == 32
+    fired = plan2.counts()["prefix_walk"]
+    assert 1 <= fired <= 32 // BLOCK  # one per chunk, chunks >= blocks
+
+
+def test_import_rejects_layout_mismatch(tiny_server, int8_server):
+    """A frame that does not match the importing server's store layout
+    (float vs int8, wrong shapes) raises and touches nothing."""
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    row = list(range(2, 40))
+    head, blocks = exp.export_blocks(row)
+    imp = PrefixStore(int8_server, block=BLOCK, budget_mb=8)
+    before = imp.stats()["blocks"]
+    with pytest.raises(ValueError, match="store layout"):
+        imp.import_blocks(head, blocks)
+    assert imp.stats()["blocks"] == before
+    # token/blocks mismatch
+    imp2 = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    with pytest.raises(ValueError, match="cover"):
+        imp2.import_blocks(head[:BLOCK], blocks)
+    # a shipped prefix that fills the whole window leaves no decode room
+    cfg = tiny_server.model.cfg
+    full = list(range(cfg.max_len))
+    fake = blocks * (cfg.max_len // BLOCK // len(blocks))
+    with pytest.raises(ValueError, match="no room"):
+        imp2.import_blocks(full, fake)
+
+
+def test_import_backpressure_propagates(tiny_server):
+    """A paged import the arena cannot hold raises PagesExhausted (the
+    priced-shed path) instead of silently caching nothing — and leaks
+    no pages."""
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    pool = mk_pool(tiny_server, n_windows=0, extra_pages=2)  # 2 usable
+    imp = PrefixStore(tiny_server, block=BLOCK, budget_mb=64, pool=pool)
+    row = list(range(11, 11 + 48 + 5))  # 48-token head = 3 blocks
+    head, blocks = exp.export_blocks(row)
+    assert len(blocks) == 3
+    free_before = pool.free_count()
+    with pytest.raises(PagesExhausted):
+        imp.import_blocks(*decode_frame(
+            encode_frame(head, exp.block, blocks))[0::2])
+    assert pool.free_count() == free_before
+    pool.check_invariants()
+
+
+def test_import_lands_despite_garbage_distractor_pages(tiny_server):
+    """Junk pages already in the arena (stale content from other rows)
+    must not bleed into an imported prefix's pages — the block-table
+    indirection isolates them."""
+    import jax.numpy as jnp
+
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=8)
+    pool = mk_pool(tiny_server)
+    imp = PrefixStore(tiny_server, block=BLOCK, budget_mb=64, pool=pool)
+    # scribble junk into a few pages the import must route around
+    junk_pids = pool.alloc(3, tokens=3 * BLOCK)
+    write = tiny_server._page_write_fn(pool.n_pages, pool.page)
+    cfg = tiny_server.model.cfg
+    rng = np.random.default_rng(7)
+    junk_block = [
+        {name: jnp.asarray(rng.normal(
+            size=(1, pool.page) + tuple(v.shape[2:])).astype(v.dtype))
+         for name, v in entry.items()}
+        for entry in init_page_arena(cfg, 2, pool.page)]
+    with pool.arena_lock:
+        arena = pool.ensure_arena()
+        for pid in junk_pids:
+            arena = write(arena, jnp.int32(pid), junk_block)
+        pool.arena = arena
+    row = list(range(21, 63))
+    off = tiny_server.generate(row, max_new_tokens=8)
+    head, blocks = exp.export_blocks(row)
+    clear_prefix_lru(tiny_server)
+    imp.import_blocks(*decode_frame(
+        encode_frame(head, exp.block, blocks))[0::2])
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    eng = ContinuousBatcher(tiny_server, slots=4, segment=8,
+                            page_pool=pool)
+    eng.prefix_pages_fn = imp.acquire_pages
+    m = imp.route(row)
+    on = eng.generate(row[m:], max_new_tokens=8, prefix=row[:m])
+    np.testing.assert_array_equal(np.asarray(on), np.asarray(off))
+    pool.release(junk_pids)
+    pool.check_invariants()
+
+
+def test_bf16_ship_roundtrip():
+    """A bfloat16 bundle's KV ships bitwise: the wire dtype names and
+    the import-side leaf template both resolve bf16 through ml_dtypes
+    (no float32 detour, no template crash)."""
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-tiny").build(dtype="bfloat16")
+    params = adapter.init_params(seed=0)
+    server = adapter.make_server(params)
+    exp = PrefixStore(server, block=BLOCK, budget_mb=8)
+    imp = PrefixStore(server, block=BLOCK, budget_mb=8)
+    row = list(range(3, 45))
+    off = np.asarray(server.generate(row, max_new_tokens=8))
+    head, blocks = exp.export_blocks(row)
+    clear_prefix_lru(server)
+    res = imp.import_blocks(*decode_frame(
+        encode_frame(head, BLOCK, blocks))[0::2])
+    assert res["inserted"] == 2
+    m = imp.route(row)
+    on = np.asarray(server.generate(row[m:], prefix=row[:m],
+                                    max_new_tokens=8))
+    np.testing.assert_array_equal(on, off)
+
+
+# -- replica HTTP endpoints ---------------------------------------------------
+
+
+def test_http_kv_ship_e2e(tmp_path):
+    """Two live bundle servers: export a prompt head from A over HTTP,
+    import the frame into B, then B's completion for the full prompt is
+    bitwise A's — and both replicas publish batching.disagg counters."""
+    import json
+    import urllib.request
+
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.bundle import assemble_bundle
+    from lambdipy_tpu.recipes.schema import load_recipe_dict
+    from lambdipy_tpu.runtime.server import BundleServer
+
+    doc = {
+        "schema": 1, "name": "kvship-e2e", "version": "0.1",
+        "device": "any", "base_layer": "jax-tpu", "requires": [],
+        "payload": {
+            "model": "llama-tiny",
+            "handler": "lambdipy_tpu.runtime.handlers:generate_handler",
+            "params": "init", "dtype": "float32",
+            "extra": {"max_new_tokens": "8", "serve_aot": "0",
+                      "warm_group_prefill": "0",
+                      "prefix_cache_mb": "32", "prefix_block": "16"},
+        },
+    }
+    result = build_recipe(load_recipe_dict(doc), tmp_path / "work",
+                          run_smoke=False)
+    bundle = tmp_path / "bundle"
+    assemble_bundle(result, bundle, with_payload=True)
+    a = BundleServer(bundle, warmup=False).start_background()
+    b = BundleServer(bundle, warmup=False).start_background()
+    try:
+        def post(port, path, data, ctype="application/json"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=data,
+                headers={"Content-Type": ctype}, method="POST")
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                return resp.status, resp.read()
+
+        row = list(range(3, 45))
+        _, ref = post(a.port, "/v1/completions", json.dumps(
+            {"prompt": row, "max_tokens": 8, "temperature": 0}).encode())
+        ref_tokens = json.loads(ref)["choices"][0]["tokens"]
+        status, frame = post(a.port, "/v1/kv/export",
+                             json.dumps({"tokens": row}).encode())
+        assert status == 200 and frame[:4] == b"LKV1"
+        status, out = post(b.port, "/v1/kv/import", frame,
+                           "application/octet-stream")
+        assert status == 200
+        imported = json.loads(out)
+        assert imported["ok"] and imported["inserted"] == 2
+        _, got = post(b.port, "/v1/completions", json.dumps(
+            {"prompt": row, "max_tokens": 8, "temperature": 0}).encode())
+        assert json.loads(got)["choices"][0]["tokens"] == ref_tokens
+        # B served the head from shipped KV: its store shows a hit
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{b.port}/metrics", timeout=30) as resp:
+            m = json.loads(resp.read())
+        dg = m["handler"]["batching"]["disagg"]
+        assert dg["imports"] == 1 and dg["import_blocks"]["inserted"] == 2
+        assert m["handler"]["prefix_cache"]["hits"] >= 1
+        # a garbage frame answers 400 and inserts nothing
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(b.port, "/v1/kv/import", b"LKV1garbage",
+                 "application/octet-stream")
+        assert ei.value.code == 400
+    finally:
+        a.stop()
+        b.stop()

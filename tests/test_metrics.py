@@ -349,3 +349,83 @@ def test_page_pool_stats_concurrent():
     rep = st.report()
     assert rep["alloc_pages"] == rep["release_pages"] == 1600
     assert rep["shares"] == 800
+
+
+# -- disaggregated-serving counters ------------------------------------------
+
+
+def test_kv_ship_stats_report_shape():
+    from lambdipy_tpu.runtime.metrics import KvShipStats
+
+    st = KvShipStats()
+    rep = st.report()
+    assert rep["exports"] == rep["imports"] == 0
+    assert rep["import_blocks"] == {"inserted": 0, "present": 0}
+    st.record_export(tokens=32, nbytes=1000)
+    st.record_import(tokens=32, nbytes=1000, inserted=2, present=0,
+                     mode="paged")
+    st.record_import(tokens=16, nbytes=600, inserted=0, present=1,
+                     mode="dense")
+    st.record_backpressure()
+    st.record_rejected()
+    rep = st.report()
+    assert rep["exports"] == 1 and rep["export_bytes"] == 1000
+    assert rep["imports"] == 2 and rep["import_bytes"] == 1600
+    assert rep["import_blocks"] == {"inserted": 2, "present": 1}
+    assert rep["imports_zero_copy"] == 1
+    assert rep["imports_assembled"] == 1
+    assert rep["import_backpressure"] == 1
+    assert rep["import_rejected"] == 1
+
+
+def test_disagg_stats_ewma_and_fallbacks():
+    from lambdipy_tpu.runtime.metrics import DisaggStats
+
+    st = DisaggStats()
+    assert st.report()["ships"] == 0
+    # first ship seeds the EWMAs exactly; later ships smooth (alpha .2)
+    st.record_ship(nbytes=1000, ms=10.0)
+    rep = st.report()
+    assert rep["ship_bytes_ewma"] == 1000.0 and rep["ship_ms_ewma"] == 10.0
+    st.record_ship(nbytes=2000, ms=20.0)
+    rep = st.report()
+    assert rep["ship_bytes_ewma"] == 1200.0
+    assert rep["ship_ms_ewma"] == 12.0
+    assert rep["ships"] == 2 and rep["ship_bytes_total"] == 3000
+    st.count("prefill_dispatches")
+    st.count("decode_dispatches")
+    st.count("ship_skips", 3)
+    st.record_fallback("export_failed")
+    st.record_fallback("export_failed")
+    st.record_fallback("no_prefill_replica")
+    st.record_import_result(inserted=2, present=1, mode="paged")
+    rep = st.report()
+    assert rep["prefill_dispatches"] == 1
+    assert rep["decode_dispatches"] == 1
+    assert rep["ship_skips"] == 3
+    assert rep["fallbacks"] == {"export_failed": 2,
+                                "no_prefill_replica": 1}
+    assert rep["import_blocks"] == {"inserted": 2, "present": 1}
+    assert rep["imports_zero_copy"] == 1
+
+
+def test_disagg_stats_threaded_counts():
+    from lambdipy_tpu.runtime.metrics import DisaggStats
+
+    st = DisaggStats()
+
+    def worker():
+        for _ in range(200):
+            st.count("ship_skips")
+            st.record_fallback("x")
+            st.record_ship(nbytes=10, ms=1.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = st.report()
+    assert rep["ship_skips"] == 800
+    assert rep["fallbacks"]["x"] == 800
+    assert rep["ships"] == 800 and rep["ship_bytes_total"] == 8000
