@@ -526,11 +526,13 @@ def _build_disagg_bundle(tmp, *, n_new: int, block: int,
 
 
 def _spawn_replica_proc(bundle, *, env_extra=None, tag="r",
-                        ready_timeout=300.0):
+                        ready_timeout=300.0, port=0):
     """Boot one bundle server as a SUBPROCESS (own jax client, own
     XLA threadpool — the disaggregation claim is about isolating
     replica workloads, which in-process replicas sharing one device
-    client cannot honestly show). Returns (proc, url, stderr_path)."""
+    client cannot honestly show). Returns (proc, url, stderr_path).
+    ``port`` pins the listen port — the sessions sweep respawns a
+    SIGKILLed replica at its old URL so the pool readmits it."""
     import subprocess
     import tempfile
 
@@ -545,7 +547,7 @@ def _spawn_replica_proc(bundle, *, env_extra=None, tag="r",
         prefix=f"lambdipy-disagg-{tag}-", suffix=".stderr", delete=False)
     proc = subprocess.Popen(
         [sys.executable, "-m", "lambdipy_tpu.runtime.server",
-         str(bundle)],
+         str(bundle)] + ([str(port)] if port else []),
         stdout=subprocess.PIPE, stderr=errf, text=True, env=env)
     ready: dict = {}
 
@@ -965,6 +967,430 @@ def _disagg_ship_failure(dec_url, pre_url, *, block, n_new, burst_len,
     finally:
         router.stop()
         pool.close()
+
+
+def _build_sessions_bundle(tmp, *, n_new: int, block: int,
+                           name: str = "sessions-bench"):
+    """The tiny llama bundle the sessions sweep serves: continuous
+    batching + prefix cache (sessions ride it), prefill_chunk pinned to
+    the block width so a cold conversation walk costs one modeled
+    device delay PER BLOCK (the TTFT story needs cold prefill that
+    scales with history length), deterministic init params so every
+    replica — and the direct reference server — is bitwise the same."""
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.bundle import assemble_bundle
+    from lambdipy_tpu.recipes.schema import load_recipe_dict
+
+    doc = {
+        "schema": 1, "name": name, "version": "0.1",
+        "device": "any", "base_layer": "jax-tpu", "requires": [],
+        "payload": {
+            "model": "llama-tiny",
+            "handler": "lambdipy_tpu.runtime.handlers:generate_handler",
+            "params": "init", "dtype": "float32",
+            "extra": {"max_new_tokens": str(n_new), "serve_aot": "0",
+                      "warm_group_prefill": "0",
+                      "prefix_cache_mb": "64",
+                      "prefix_block": str(block),
+                      "prefill_chunk": str(block),
+                      "max_len": "512", "hidden": "64",
+                      "batch_mode": "continuous",
+                      "batch_max": "4", "batch_segment": "8"},
+        },
+    }
+    result = build_recipe(load_recipe_dict(doc), tmp / "work",
+                          run_smoke=False)
+    bundle = tmp / "bundle"
+    assemble_bundle(result, bundle, with_payload=True)
+    return bundle
+
+
+def _conv_prompts(seed, *, first_len, user_len, turns, vocab=500):
+    """Deterministic conversation schedule: the opening prompt plus the
+    per-turn user extensions (completions get appended as they arrive,
+    so the full history is schedule + transcript)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    first = [int(t) for t in rng.integers(1, vocab, size=first_len)]
+    users = [[int(t) for t in rng.integers(1, vocab, size=user_len)]
+             for _ in range(turns)]
+    return first, users
+
+
+def sessions_record(*, block: int = 64, first_len: int = 321,
+                    user_len: int = 16, n_new: int = 24, turns: int = 3,
+                    walk_ms: float = 400.0, ttft_gate: float = 0.15,
+                    expiry_ttl_s: float = 2.0) -> dict:
+    """Multi-turn session sweep (CPU-runnable, SUBPROCESS replicas
+    behind the sticky-session router). Four claims, each a hard assert,
+    run over {dense, paged} KV x {greedy, seeded-sampled} x {healthy,
+    mid-conversation replica SIGKILL}:
+
+    1. PARITY — every turn of every conversation through the fleet is
+       BITWISE the direct single-server transcript, including the turns
+       served right after the session's home replica is SIGKILLed
+       (failover re-prefill) and after it restarts.
+    2. ZERO ERRORS — no conversation turn ever surfaces a client error,
+       kill and failover included.
+    3. TTFT — with a healthy home, turn-2+ TTFT is <= ``ttft_gate`` x
+       the cold turn-1 TTFT: the pinned, sticky-routed history skips
+       the whole-history prefill (cold walk device time modeled per
+       block through the deterministic ``prefix_walk`` delay site, the
+       --disagg idiom — real tiny-model prefill is too cheap on CPU to
+       carry a latency claim).
+    4. PINS DRAIN — after every session closes (explicit DELETE fan-out
+       plus one session left to LEASE EXPIRY), each live replica's
+       pinned-leaf/pinned-byte accounting reads exactly zero.
+
+    The dense fleet additionally exercises a REACHABLE-home failover
+    (eject stand-in with the process alive): the session's whole-block
+    KV head re-ships old home -> new home and the re-ship counter moves.
+
+    ``first_len`` defaults to one past a block boundary so the cacheable
+    turn-1 target lands block-aligned (320 = 5 x 64): warm turns whose
+    growth stays inside one block then walk ZERO cold chunks, which is
+    what the TTFT claim is about — the alternative alignment would
+    charge every warm turn one block of walk and measure block geometry,
+    not session pinning.
+    """
+    import tempfile
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+    from pathlib import Path
+
+    from lambdipy_tpu.fleet import EJECTED, FleetRouter, ReplicaPool
+
+    tmp = Path(tempfile.mkdtemp(prefix="lambdipy-sessions-bench-"))
+    bundle = _build_sessions_bundle(tmp, n_new=n_new, block=block)
+
+    def post(base, path, payload, timeout=300):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def completion(base, row, *, max_tokens, session=None, ttl=None,
+                   **kw):
+        body = {"prompt": [int(t) for t in row],
+                "max_tokens": max_tokens,
+                "temperature": kw.get("temperature", 0)}
+        for k in ("seed", "top_p"):
+            if k in kw:
+                body[k] = kw[k]
+        if session is not None:
+            body["session_id"] = session
+        if ttl is not None:
+            body["session_ttl_s"] = ttl
+        return post(base, "/v1/completions", body)["choices"][0]["tokens"]
+
+    def metrics(base):
+        with urllib.request.urlopen(f"{base}/metrics",
+                                    timeout=60) as resp:
+            return json.loads(resp.read())
+
+    # the direct single-server REFERENCE (no walk delay — the delay
+    # models device time, it never changes tokens): transcripts the
+    # fleet must reproduce bitwise
+    ref_proc, ref_url, _ = _spawn_replica_proc(bundle, tag="ref")
+    ref_cache: dict = {}
+
+    def ref_transcript(seed, *, nturns, per_turn_new, kw):
+        ck = (seed, nturns, per_turn_new, tuple(sorted(kw)))
+        if ck in ref_cache:
+            return ref_cache[ck]
+        first, users = _conv_prompts(seed, first_len=first_len,
+                                     user_len=user_len, turns=nturns)
+        history, out = list(first), []
+        for t in range(nturns):
+            toks = completion(ref_url, history,
+                              max_tokens=per_turn_new, **kw)
+            out.append(toks)
+            history = history + toks + users[t]
+        ref_cache[ck] = out
+        return out
+
+    SAMPLED = {"temperature": 0.9, "seed": 7, "top_p": 0.9}
+    result: dict = {"mode": "sessions", "block": block, "n_new": n_new,
+                    "turns": turns, "walk_ms": walk_ms}
+
+    def run_fleet(label: str, paged: bool, seed_base: int) -> dict:
+        env_extra = {"LAMBDIPY_FAULT":
+                     f"prefix_walk:delay@ms={walk_ms:g},n=inf"}
+        if paged:
+            env_extra.update({"LAMBDIPY_KV_PAGED": "1",
+                              "LAMBDIPY_KV_PAGES": "96"})
+        procs: dict = {}
+        (p0, url0, _), (p1, url1, _) = (
+            _spawn_replica_proc(bundle, env_extra=env_extra,
+                                tag=f"{label}0"),
+            _spawn_replica_proc(bundle, env_extra=env_extra,
+                                tag=f"{label}1"))
+        procs["r0"] = [p0, url0]
+        procs["r1"] = [p1, url1]
+        pool = ReplicaPool(probe_interval=0.5, fail_threshold=1,
+                           readmit_passes=2, probe_timeout=10.0)
+        pool.attach("r0", url0)
+        pool.attach("r1", url1)
+        pool.probe_all()
+        pool.start()
+        router = FleetRouter(pool, affinity_on=True, block=block,
+                             max_retries=2, request_timeout=300)
+        router.start_background()
+        base = f"http://127.0.0.1:{router.port}"
+        out: dict = {}
+        errors: list = []
+
+        def turn(sid, history, per_turn_new, kw, ttl=None):
+            try:
+                return completion(base, history,
+                                  max_tokens=per_turn_new,
+                                  session=sid, ttl=ttl, **kw)
+            except Exception as e:  # noqa: BLE001 — the zero-error bar
+                errors.append(f"{sid}: {type(e).__name__}: {e}")
+                raise
+
+        def run_conv(sid, seed, *, nturns, per_turn_new, kw,
+                     pre_turn=None):
+            """Drive one conversation; returns per-turn transcripts,
+            asserting bitwise parity vs the direct reference."""
+            ref = ref_transcript(seed, nturns=nturns,
+                                 per_turn_new=per_turn_new, kw=kw)
+            first, users = _conv_prompts(seed, first_len=first_len,
+                                         user_len=user_len,
+                                         turns=nturns)
+            history, times = list(first), []
+            for t in range(nturns):
+                if pre_turn is not None:
+                    pre_turn(t, sid)
+                t0 = time.monotonic()
+                toks = turn(sid, history, per_turn_new, kw)
+                times.append(time.monotonic() - t0)
+                if toks != ref[t]:
+                    raise AssertionError(
+                        f"sessions {label}: {sid} turn {t} diverged "
+                        f"from the direct transcript")
+                history = history + toks + users[t]
+            return times
+
+        try:
+            # off-the-clock compile warm on EACH replica directly (the
+            # subprocesses do not share a compile cache): the
+            # conversation shapes the TTFT gate times must hit warm
+            # programs, not first-use XLA compiles
+            for url in (url0, url1):
+                for per_turn_new in (n_new, 1):
+                    first, users = _conv_prompts(
+                        900 + per_turn_new, first_len=first_len,
+                        user_len=user_len, turns=2)
+                    history = list(first)
+                    for t in range(2):
+                        toks = completion(url, history,
+                                          max_tokens=per_turn_new)
+                        history = history + toks + users[t]
+
+            # -- healthy conversations, concurrent (greedy + sampled) --
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                futs = [
+                    ex.submit(run_conv, "healthy-g", seed_base + 1,
+                              nturns=turns, per_turn_new=n_new, kw={}),
+                    ex.submit(run_conv, "healthy-s", seed_base + 2,
+                              nturns=turns, per_turn_new=n_new,
+                              kw=SAMPLED),
+                ]
+                for f in futs:
+                    f.result()
+            # pins are LIVE while sessions are open — observable
+            pinned_now = sum(
+                metrics(rec[1])["handler"]["prefix_cache"]
+                ["pinned_leaves"] for rec in procs.values())
+            if pinned_now <= 0:
+                raise AssertionError(
+                    f"sessions {label}: no pinned leaves while two "
+                    f"conversations are open — pins are not engaging")
+            out["healthy"] = {"conversations": 2, "turns": turns,
+                              "pinned_leaves_live": pinned_now}
+
+            # -- TTFT: cold turn 1 vs sticky pinned turns 2+ -----------
+            times = run_conv("ttft", seed_base + 3, nturns=turns,
+                             per_turn_new=1, kw={})
+            t_cold, t_warm = times[0], min(times[1:])
+            out["ttft"] = {"cold_s": round(t_cold, 3),
+                           "warm_s": round(t_warm, 3),
+                           "ratio": round(t_warm / t_cold, 4),
+                           "gate": ttft_gate}
+            if t_warm > ttft_gate * t_cold:
+                raise AssertionError(
+                    f"sessions {label}: turn-2+ TTFT {t_warm:.3f}s is "
+                    f"{t_warm / t_cold:.2f}x cold {t_cold:.3f}s "
+                    f"(gate {ttft_gate}x) — the pinned sticky path is "
+                    f"not skipping the history prefill")
+
+            # -- mid-conversation SIGKILL of the session's home --------
+            kill_turns = turns + (1 if not paged else 0)
+            refs = {
+                "kill-g": (seed_base + 4, n_new, {}),
+                "kill-s": (seed_base + 5, n_new, SAMPLED),
+            }
+            convs = {}
+            for sid, (seed, ptn, kw) in refs.items():
+                first, users = _conv_prompts(seed, first_len=first_len,
+                                             user_len=user_len,
+                                             turns=kill_turns)
+                convs[sid] = {
+                    "history": list(first), "users": users, "kw": kw,
+                    "ref": ref_transcript(seed, nturns=kill_turns,
+                                          per_turn_new=ptn, kw=kw)}
+
+            def kill_step(sid, t):
+                c = convs[sid]
+                toks = turn(sid, c["history"], n_new, c["kw"])
+                if toks != c["ref"][t]:
+                    raise AssertionError(
+                        f"sessions {label}: {sid} turn {t} diverged "
+                        f"(kill case)")
+                c["history"] = c["history"] + toks + c["users"][t]
+
+            for sid in convs:
+                kill_step(sid, 0)
+            home = router._session_map["kill-g"]["home"]
+            survivor = "r1" if home == "r0" else "r0"
+            failovers_before = router.sessions.report()["failovers"]
+            procs[home][0].kill()
+            deadline = time.monotonic() + 30
+            while pool.replicas[home].state != EJECTED:
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"sessions {label}: {home} not ejected after "
+                        f"SIGKILL")
+                time.sleep(0.1)
+            # the surviving turns: zero errors, bitwise parity — the
+            # failover's local re-prefill IS the recovery path. Both
+            # conversations advance concurrently, turn-aligned (a
+            # conversation's own turns are inherently sequential).
+            for t in range(1, turns):
+                with ThreadPoolExecutor(max_workers=2) as ex:
+                    list(ex.map(lambda sid, tt=t: kill_step(sid, tt),
+                                convs))
+            srep = router.sessions.report()
+            if srep["failovers"] <= failovers_before:
+                raise AssertionError(
+                    f"sessions {label}: SIGKILL never triggered a "
+                    f"session failover: {srep}")
+            if srep["reship_fallbacks"].get("old_home_unreachable",
+                                            0) < 1:
+                raise AssertionError(
+                    f"sessions {label}: dead-home failover was not "
+                    f"counted as old_home_unreachable: {srep}")
+            out["kill"] = {
+                "killed": home, "survivor": survivor,
+                "failovers": srep["failovers"] - failovers_before,
+                "reship_fallbacks": dict(srep["reship_fallbacks"]),
+            }
+
+            if not paged:
+                # restart the killed replica at its OLD URL: the pool
+                # readmits it and the conversation keeps serving
+                port = int(procs[home][1].rsplit(":", 1)[1])
+                proc, url, _ = _spawn_replica_proc(
+                    bundle, env_extra=env_extra, tag=f"{label}-re",
+                    port=port)
+                procs[home][0] = proc
+                deadline = time.monotonic() + 120
+                while not pool.replicas[home].routable:
+                    if time.monotonic() > deadline:
+                        raise AssertionError(
+                            f"sessions {label}: {home} never readmitted "
+                            f"after restart")
+                    time.sleep(0.2)
+                kill_step("kill-g", turns)  # one post-restart turn
+                out["kill"]["restarted"] = True
+
+                # -- reachable-home failover: the KV RE-SHIP leg -------
+                run_conv("reship", seed_base + 6, nturns=1,
+                         per_turn_new=n_new, kw={})
+                rhome = router._session_map["reship"]["home"]
+                reships_before = router.sessions.report()["reships"]
+                pool.replicas[rhome].state = EJECTED  # drain stand-in
+                first, users = _conv_prompts(seed_base + 6,
+                                             first_len=first_len,
+                                             user_len=user_len,
+                                             turns=2)
+                ref2 = ref_transcript(seed_base + 6, nturns=2,
+                                      per_turn_new=n_new, kw={})
+                history = list(first) + ref2[0] + users[0]
+                toks = turn("reship", history, n_new, {})
+                if toks != ref2[1]:
+                    raise AssertionError(
+                        f"sessions {label}: re-ship turn diverged")
+                srep = router.sessions.report()
+                if srep["reships"] <= reships_before:
+                    raise AssertionError(
+                        f"sessions {label}: reachable-home failover "
+                        f"did not re-ship the session KV: {srep}")
+                out["reship"] = {"from": rhome,
+                                 "reships": srep["reships"]}
+
+            # -- pins drain to zero: DELETE fan-out + lease expiry -----
+            exp_sid = "expiry"
+            run_conv(exp_sid, seed_base + 7, nturns=1, per_turn_new=1,
+                     kw={})
+            # tighten the lease AFTER the turn: renew with a short ttl
+            hist_first, _ = _conv_prompts(seed_base + 7,
+                                          first_len=first_len,
+                                          user_len=user_len, turns=1)
+            turn(exp_sid, hist_first, 1, {}, ttl=expiry_ttl_s)
+            for sid in ("healthy-g", "healthy-s", "ttft", "kill-g",
+                        "kill-s", "reship"):
+                req = urllib.request.Request(
+                    f"{base}/v1/sessions/{sid}", method="DELETE")
+                try:
+                    urllib.request.urlopen(req, timeout=30).read()
+                except Exception:  # noqa: BLE001 — missing sessions ok
+                    pass
+            time.sleep(expiry_ttl_s + 0.5)  # the expiry session lapses
+            pins = {}
+            for name, rec in procs.items():
+                if pool.replicas[name].state == EJECTED:
+                    continue  # died with its pins; nothing to drain
+                pc = metrics(rec[1])["handler"]["prefix_cache"]
+                pins[name] = {"pinned_leaves": pc["pinned_leaves"],
+                              "pinned_bytes": pc["pinned_bytes"],
+                              "sessions_active": pc["sessions_active"],
+                              "pin_expiries": pc["pin_expiries"]}
+                if pc["pinned_leaves"] != 0 or pc["pinned_bytes"] != 0 \
+                        or pc["sessions_active"] != 0:
+                    raise AssertionError(
+                        f"sessions {label}: pins did not return to "
+                        f"zero on {name}: {pc}")
+            if sum(p["pin_expiries"] for p in pins.values()) < 1:
+                raise AssertionError(
+                    f"sessions {label}: the lease-expiry session never "
+                    f"lapsed: {pins}")
+            out["pins_zero"] = pins
+            if errors:
+                raise AssertionError(
+                    f"sessions {label}: client-visible errors: "
+                    f"{errors[:3]}")
+            out["client_errors"] = 0
+            return out
+        finally:
+            router.stop()
+            pool.close()
+            for rec in procs.values():
+                rec[0].kill()
+
+    try:
+        result["dense"] = run_fleet("dense", paged=False, seed_base=100)
+        result["paged"] = run_fleet("paged", paged=True, seed_base=200)
+    finally:
+        ref_proc.kill()
+    result["passed"] = True
+    import jax
+
+    result["platform"] = jax.devices()[0].platform
+    return result
 
 
 def fleet_record(*, replicas: int = 2, requests_per_group: int = 6,
@@ -2445,6 +2871,27 @@ def _disagg_main() -> int:
     return 0
 
 
+def _sessions_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sessions", action="store_true")
+    ap.add_argument("--block", type=int, default=64)
+    ap.add_argument("--first-len", type=int, default=321)
+    ap.add_argument("--user-len", type=int, default=16)
+    ap.add_argument("--n-new", type=int, default=24)
+    ap.add_argument("--turns", type=int, default=3)
+    ap.add_argument("--walk-ms", type=float, default=400.0)
+    ap.add_argument("--ttft-gate", type=float, default=0.15)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(sessions_record(
+        block=args.block, first_len=args.first_len,
+        user_len=args.user_len, n_new=args.n_new, turns=args.turns,
+        walk_ms=args.walk_ms, ttft_gate=args.ttft_gate)))
+    return 0
+
+
 def _chaos_fleet_main() -> int:
     import argparse
 
@@ -2747,6 +3194,14 @@ def main() -> int:
         # replica count, and injected ship failure completing the
         # burst with zero client-visible errors
         return _disagg_main()
+    if "--sessions" in sys.argv:
+        # CPU-runnable multi-turn session sweep (subprocess replicas):
+        # bitwise transcript parity vs direct serving across {greedy,
+        # seeded-sampled} x {dense, paged} x {healthy, mid-conversation
+        # replica SIGKILL}, zero client-visible errors through failover,
+        # turn-2+ TTFT <= 0.15x cold on a healthy home, and pin
+        # accounting returning to exactly zero after sessions close
+        return _sessions_main()
     if "--chaos-fleet" in sys.argv:
         # CPU-runnable fleet-boundary chaos matrix: router-side network
         # faults (drop/latency/mid-body/flap) + a fleet-wide shed burst

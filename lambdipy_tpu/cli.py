@@ -458,6 +458,19 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
 @click.option("--prefix-block", type=int, default=None,
               help="token-block granularity of prefix reuse (rounded "
                    "to a pow-2 dividing the context window; default 32)")
+@click.option("--session-pin-budget", type=float, default=None,
+              help="MB of prefix-cache KV open multi-turn sessions may "
+                   "PIN out of eviction's reach (x-session-id header / "
+                   "session_id body field); beyond it new sessions shed "
+                   "503 reason session_pins with Retry-After from the "
+                   "lease-expiry horizon (default: half the prefix "
+                   "cache budget; clamped to the cache budget)")
+@click.option("--session-ttl", type=float, default=None,
+              help="absolute session pin lease in seconds — a pinned "
+                   "conversation lapses this long after it OPENED even "
+                   "if turns keep renewing the idle lease (default "
+                   "3600; idle lease defaults to 600, tunable per "
+                   "bundle via session_idle_s)")
 @click.option("--pipeline-depth", type=int, default=None,
               help="decode segments kept in flight on the device before "
                    "the host fetches the oldest (continuous engine): 1 "
@@ -506,7 +519,8 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "(default: bundle mesh extra, else single-device)")
 def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
-              prefix_block, pipeline_depth, engine_watchdog, kv_paged,
+              prefix_block, session_pin_budget, session_ttl,
+              pipeline_depth, engine_watchdog, kv_paged,
               kv_pages, spec_k, mesh_spec):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
@@ -518,6 +532,11 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_PREFIX_CACHE_MB"] = str(prefix_cache_mb)
     if prefix_block is not None:
         os.environ["LAMBDIPY_PREFIX_BLOCK"] = str(prefix_block)
+    if session_pin_budget is not None:
+        os.environ["LAMBDIPY_SESSION_PIN_BUDGET_MB"] = \
+            str(session_pin_budget)
+    if session_ttl is not None:
+        os.environ["LAMBDIPY_SESSION_TTL_S"] = str(session_ttl)
     if pipeline_depth is not None:
         os.environ["LAMBDIPY_PIPELINE_DEPTH"] = str(pipeline_depth)
     if engine_watchdog is not None:
@@ -633,11 +652,21 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
                    "(runtime/faults.py grammar over the route_connect/"
                    "route_body/route_latency/probe sites), default "
                    "$LAMBDIPY_FLEET_FAULT")
+@click.option("--session-pin-budget", type=float, default=None,
+              help="per-replica MB of prefix-cache KV open multi-turn "
+                   "sessions may pin (see `lambdipy serve "
+                   "--session-pin-budget`); the router routes sessions "
+                   "STICKY to the replica holding their pinned KV and "
+                   "re-ships it on failover")
+@click.option("--session-ttl", type=float, default=None,
+              help="per-replica absolute session pin lease in seconds "
+                   "(see `lambdipy serve --session-ttl`)")
 def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
               affinity, block, probe_interval, fail_threshold,
               readmit_passes, retries, saturation, hedge, timeout,
               engine_watchdog, attach_urls, spill_cap, spill_max_wait,
-              breaker_fails, breaker_open_s, retry_budget, fault_spec):
+              breaker_fails, breaker_open_s, retry_budget, fault_spec,
+              session_pin_budget, session_ttl):
     """Serve a bundle from N supervised replicas behind one router.
 
     Spawns REPLICAS watchdogged deployments of BUNDLE, health-probes
@@ -701,8 +730,15 @@ def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
                        fail_threshold=fail_threshold,
                        readmit_passes=readmit_passes,
                        faults=fleet_faults)
-    replica_env = ({"LAMBDIPY_ENGINE_WATCHDOG_S": str(engine_watchdog)}
-                   if engine_watchdog is not None else None)
+    replica_env = {}
+    if engine_watchdog is not None:
+        replica_env["LAMBDIPY_ENGINE_WATCHDOG_S"] = str(engine_watchdog)
+    if session_pin_budget is not None:
+        replica_env["LAMBDIPY_SESSION_PIN_BUDGET_MB"] = \
+            str(session_pin_budget)
+    if session_ttl is not None:
+        replica_env["LAMBDIPY_SESSION_TTL_S"] = str(session_ttl)
+    replica_env = replica_env or None
     spawned = []
     try:
         runtime = LocalRuntime()

@@ -150,6 +150,32 @@ def ship_prompt(request: dict, *, block: int = DEFAULT_BLOCK,
     return head if isinstance(head, list) else None
 
 
+# sessions re-ship their whole conversation head on failover, so the
+# session head is far wider than the affinity key window — but BOUNDED:
+# the router keeps one head per live session, and an unbounded head
+# would grow router memory with context length. 256 blocks (8-16k
+# tokens at the default widths) covers every context window this stack
+# serves; a longer conversation's tail simply re-prefills on the new
+# home after a failover — the documented degraded path, never a loss.
+# (The export leg also clamps to the replica's window server-side.)
+SESSION_KEY_BLOCKS = 256
+
+
+def session_key(session_id) -> bytes:
+    """Rendezvous key for session FAILOVER re-targeting: where an open
+    session lands when its home replica dies or drains. Deliberately
+    namespaced away from prefix keys (two sessions sharing a system
+    prompt should spread on failover, not pile onto one survivor).
+
+    NOT for first-turn/unknown-session placement: a session id the
+    router has never seen (first turn, or any turn after a router
+    restart) must fall back to NORMAL prefix affinity over the request
+    body — hashing the bare session id would scatter the first
+    post-restart turn away from the replica whose radix cache already
+    holds the conversation from before the restart."""
+    return b"sess\x00" + str(session_id).encode()
+
+
 def pick_replica(key: bytes, names) -> str | None:
     """Rendezvous-hash ``key`` onto one of ``names`` (any iterable of
     replica names). Deterministic; removing a name never remaps keys

@@ -70,6 +70,28 @@ rendezvous-hashes over the decode-capable replicas only — unless NO
 decode-capable replica is routable, in which case the router degrades
 to the prefill class rather than browning out (mixed-mode again).
 
+STICKY SESSIONS (multi-turn chat): a request carrying ``x-session-id``
+(or a ``session_id`` body field) routes STICKY — the session id
+overrides prefix-affinity rendezvous so every turn lands on the replica
+holding the conversation's PINNED radix KV (runtime/prefixstore.py
+session pins), making turn-2+ TTFT ~0 prefill. A session the router has
+never seen (first turn, or any turn after a router restart) falls back
+to NORMAL prefix affinity over the body — never a hash of the bare
+session id, which would scatter the first post-restart turn away from
+the replica whose radix cache still holds the conversation — and the
+replica that actually serves becomes the recorded home. When the home
+is ejected/draining, the router performs a SESSION FAILOVER: re-target
+by rendezvous over the surviving decode-capable membership and RE-SHIP
+the session's whole-block KV head to the new home through the existing
+``/v1/kv/export`` → ``/v1/kv/import`` legs (the per-replica ship-dedup
+LRU forgets the session's prefix on failover so later phase-split ships
+re-send). Every re-ship failure degrades to counted mixed-mode local
+re-prefill on the new home — in the common SIGKILL case the old home's
+KV died with the worker, so that fallback IS the recovery path and the
+re-prefilled turn is bitwise the same answer. ``DELETE
+/v1/sessions/{id}`` fans out to the decode-capable replicas (releasing
+their pins) and drops the router's sticky record.
+
 ``GET /metrics`` aggregates every replica's own ``/metrics`` (so the
 fleet-wide prefix-cache hit rate is one read) and adds the router's
 counters (runtime/metrics.RouterStats) plus the pool's per-replica
@@ -96,13 +118,15 @@ from lambdipy_tpu.fleet.pool import PREFILL, Replica, ReplicaPool
 from lambdipy_tpu.fleet.spill import SPILL_DEADLINE, SpillQueue
 from lambdipy_tpu.runtime.deploy import _http_json
 from lambdipy_tpu.runtime.faults import FaultPlan, InjectedFault
-from lambdipy_tpu.runtime.metrics import DisaggStats, RouterStats
+from lambdipy_tpu.runtime.metrics import (DisaggStats, RouterStats,
+                                          SessionStats)
 from lambdipy_tpu.sched.admission import Shed
 from lambdipy_tpu.utils.logs import get_logger, log_event
 
 log = get_logger("lambdipy.fleet.router")
 
-_FORWARD_HEADERS = ("x-priority", "x-deadline-ms", "x-api-key", "x-tenant")
+_FORWARD_HEADERS = ("x-priority", "x-deadline-ms", "x-api-key", "x-tenant",
+                    "x-session-id", "x-session-ttl-s")
 _ROUTED_PATHS = ("/invoke", "/v1/completions")
 
 
@@ -166,6 +190,15 @@ class FleetRouter:
         self._shipped: dict[str, OrderedDict] = {}
         self._shipped_cap = 512
         self._ship_lock = threading.Lock()
+        # sticky multi-turn sessions: sid -> {home, head, key}, LRU-
+        # bounded (losing a record only loses stickiness — the next turn
+        # re-places by prefix affinity, which is where the KV lives
+        # anyway). `head` is the conversation's whole-block token head,
+        # what a failover re-ship exports from the old home.
+        self.sessions = SessionStats()
+        self._session_map: OrderedDict = OrderedDict()
+        self._session_cap = 4096
+        self._session_lock = threading.Lock()
         # on_admit is always hooked: it clears the shipped-key cache
         # for a readmitted replica, then (when enabled) cache-warms it
         pool.on_admit = self._on_replica_admitted
@@ -219,7 +252,14 @@ class FleetRouter:
                       cause=b.last_cause)
 
     def _pick(self, key: bytes | None, exclude: set,
-              *, count_affinity: bool) -> Replica | None:
+              *, count_affinity: bool,
+              prefer: str | None = None) -> Replica | None:
+        """``prefer`` is the sticky-session home: when it is among the
+        usable candidates it wins outright (the conversation's pinned
+        KV lives there); otherwise the pick degrades to normal affinity
+        — the failover path has already re-homed the session by the
+        time a pick can miss, so a miss here is only the narrow race
+        between the sticky check and the pick."""
         def usable(rs):
             return [r for r in rs if r.name not in exclude
                     and not self._breaker_blocked(r)]
@@ -246,6 +286,19 @@ class FleetRouter:
         if not cands:
             return None
         chosen: Replica
+        if prefer is not None:
+            sticky = next((r for r in cands if r.name == prefer), None)
+            # the saturation valve applies to sticky homes like any
+            # other target: a replica hosting many hot sessions must
+            # spill past the threshold (the turn re-homes and pays one
+            # re-prefill) instead of melting while the fleet idles
+            if sticky is not None and \
+                    sticky.outstanding < self.saturation:
+                b = self._breaker(sticky)
+                if b is not None:
+                    b.begin_attempt()
+                return sticky
+            self.sessions.count("sticky_misses")
         if key is not None and self.affinity_on:
             target_name = affinity.pick_replica(
                 key, sorted(r.name for r in cands))
@@ -451,10 +504,234 @@ class FleetRouter:
                           error=str(e))
                 return  # an unhealthy target: stop, health owns it now
 
+    # -- sticky multi-turn sessions ------------------------------------------
+
+    @staticmethod
+    def _session_id(headers, body: dict) -> str | None:
+        """Same precedence as the replica server's `_session_header`:
+        the BODY field wins over the header — both layers must track
+        one request under one id, or a DELETE through the router would
+        release nothing while the replica's pins live on."""
+        sid = body.get("session_id")
+        if sid is None or not str(sid):
+            sid = headers.get("x-session-id")
+        # same acceptance as the handler (`session_id: 0` is a valid
+        # id): only None/empty fall through
+        return str(sid) if sid is not None and str(sid) else None
+
+    def _decode_capable(self) -> dict[str, Replica]:
+        """Name -> replica for every usable sticky/failover target."""
+        return {r.name: r for r in self.pool.routable()
+                if r.role != PREFILL and not self._breaker_blocked(r)}
+
+    def _session_sticky(self, sid: str, body: dict) -> str | None:
+        """Resolve the session's home replica for this turn: the
+        recorded home when it is still routable (sticky hit), a freshly
+        failed-over home when it is not, or None for a session the
+        router has never seen — the caller then places the turn by
+        NORMAL prefix affinity (the post-restart first turn must land
+        where the prompt's prefix key says the KV lives, not where a
+        hash of the session id scatters it) and records whoever
+        serves."""
+        with self._session_lock:
+            rec = self._session_map.get(sid)
+        if rec is None:
+            # unknown session: no head to extend — _note_session_home
+            # computes it once after the serving replica is known
+            return None
+        head = affinity.ship_prompt(
+            body, block=self.block,
+            key_blocks=affinity.SESSION_KEY_BLOCKS)
+        with self._session_lock:
+            # re-check: a concurrent DELETE (or the cap sweep) may have
+            # dropped the record while ship_prompt ran unlocked
+            if sid not in self._session_map:
+                return None
+            self._session_map.move_to_end(sid)
+            # each turn's prompt extends the conversation: keep the
+            # LONGEST head seen — that is what a failover re-ships
+            if head is not None and (rec["head"] is None
+                                     or len(head) > len(rec["head"])):
+                rec["head"] = head
+            home = rec["home"]
+        cands = self._decode_capable()
+        if home in cands:
+            self.sessions.count("sticky_hits")
+            return home
+        return self._session_failover(sid, rec, cands)
+
+    def _session_failover(self, sid: str, rec: dict,
+                          cands: dict[str, Replica]) -> str | None:
+        """The home died or drained: re-target via rendezvous over the
+        SURVIVING decode-capable membership and try to re-ship the
+        session's whole-block KV head from the old home to the new one.
+        Every failure of the re-ship degrades to counted mixed-mode
+        local re-prefill on the new home — when the old home is
+        unreachable (the SIGKILL case: its radix cache died with the
+        worker) that fallback IS the recovery, and the re-prefilled
+        turn is bitwise the same answer."""
+        if not cands:
+            return None  # nothing decode-capable: _pick's degrade owns it
+        self.sessions.count("failovers")
+        old_home = rec["home"]
+        new_home = affinity.pick_replica(affinity.session_key(sid),
+                                         sorted(cands))
+        with self._session_lock:
+            rec["home"] = new_home
+        # the ship-dedup LRU must forget this session's prefix: the new
+        # home may carry a stale entry from pre-failover phase-split
+        # traffic, and the old home's entry is meaningless now
+        akey = rec.get("key")
+        if akey is not None:
+            with self._ship_lock:
+                for seen in self._shipped.values():
+                    seen.pop(akey, None)
+        reason = self._session_reship(rec.get("head"), old_home,
+                                      cands[new_home])
+        if reason is None:
+            self.sessions.count("reships")
+            if akey is not None:
+                # the new home now holds the head: the phase-split
+                # dedup should skip the very next turn's ship for it
+                with self._ship_lock:
+                    seen = self._shipped.setdefault(new_home,
+                                                    OrderedDict())
+                    seen[akey] = True
+                    while len(seen) > self._shipped_cap:
+                        seen.popitem(last=False)
+            log_event(log, "session failed over with KV re-ship",
+                      session=sid[:16], old=old_home, new=new_home)
+        else:
+            self.sessions.record_fallback(reason)
+            log_event(log, "session failed over, local re-prefill",
+                      session=sid[:16], old=old_home, new=new_home,
+                      reason=reason)
+        return new_home
+
+    def _session_reship(self, head, old_name: str | None,
+                        new_rep: Replica) -> str | None:
+        """Export the session head's KV from the old home and import it
+        on the new one. Returns None on success, else the fallback
+        reason. Both legs ride :meth:`_forward` (breakers see them);
+        neither retries — a failed re-ship costs one local re-prefill,
+        never a lost turn."""
+        try:
+            self.faults.check("session_failover")
+        except InjectedFault:
+            return "failover_fault"
+        if head is None:
+            return "no_token_head"
+        old = self.pool.replicas.get(old_name) if old_name else None
+        if old is None:
+            return "no_old_home"
+        try:
+            status, _, frame = self._forward(
+                old, "/v1/kv/export",
+                json.dumps({"tokens": head}).encode(),
+                {"Content-Type": "application/json"})
+        except Exception:  # noqa: BLE001 — the SIGKILL case
+            return "old_home_unreachable"
+        if status != 200:
+            return "export_failed"
+        try:
+            istatus, _, _ = self._forward(
+                new_rep, "/v1/kv/import", frame,
+                {"Content-Type": "application/octet-stream"})
+        except Exception as e:  # noqa: BLE001
+            if not self._is_timeout(e):
+                self.pool.note_failure(new_rep)
+            return "import_failed"
+        if istatus in (429, 503):
+            return "import_backpressure"
+        if istatus != 200:
+            return "import_failed"
+        return None
+
+    def _note_session_home(self, sid: str | None, replica_name: str,
+                           body: dict, key: bytes | None) -> None:
+        """Record (or refresh) the replica that actually SERVED this
+        session's turn — first turns create the record, retry/failover
+        outcomes self-heal it."""
+        if sid is None:
+            return
+        with self._session_lock:
+            rec = self._session_map.get(sid)
+            if rec is not None:
+                # known session: _session_sticky already folded this
+                # turn's head into the record — only the home (and the
+                # key) need refreshing, no second O(history) extraction
+                rec["home"] = replica_name
+                if key is not None:
+                    rec["key"] = key
+                self._session_map.move_to_end(sid)
+                return
+        head = affinity.ship_prompt(
+            body, block=self.block,
+            key_blocks=affinity.SESSION_KEY_BLOCKS)
+        with self._session_lock:
+            rec = self._session_map.get(sid)
+            if rec is None:
+                self._session_map[sid] = {"home": replica_name,
+                                          "head": head, "key": key}
+                self.sessions.count("opened")
+                while len(self._session_map) > self._session_cap:
+                    self._session_map.popitem(last=False)
+            else:  # a racer created it between the two locked sections
+                rec["home"] = replica_name
+                if key is not None:
+                    rec["key"] = key
+                if head is not None and (rec["head"] is None
+                                         or len(head) > len(rec["head"])):
+                    rec["head"] = head
+            self._session_map.move_to_end(sid)
+
+    def _end_session(self, sid: str, handler) -> None:
+        """DELETE /v1/sessions/{id}: drop the sticky record and fan the
+        DELETE out to every decode-capable replica — after failovers the
+        session's pins may live on more than one, and an extra DELETE on
+        a replica that never pinned it is an idempotent no-op."""
+        with self._session_lock:
+            self._session_map.pop(sid, None)
+        self.sessions.count("deletes")
+        released: dict = {}
+        released_lock = threading.Lock()
+
+        def close_on(name: str, url: str) -> None:
+            req = urllib.request.Request(
+                f"{url}/v1/sessions/{sid}", method="DELETE")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.pool.probe_timeout) as resp:
+                    out = json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                out = {"ok": False, "status": e.code}
+            except Exception as e:  # noqa: BLE001 — dead replica: its
+                # pins died with it, nothing left to release
+                out = {"ok": False, "error": str(e)}
+            with released_lock:
+                released[name] = out
+
+        # concurrent like the /metrics scrape: one wedged replica costs
+        # its own timeout, not timeout x fleet serially on the client
+        threads = [threading.Thread(target=close_on, args=(n, r.url),
+                                    daemon=True)
+                   for n, r in sorted(self.pool.replicas.items())
+                   if r.role != PREFILL]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.pool.probe_timeout + 2.0)
+        with released_lock:
+            # snapshot: a straggler thread past the join bound must not
+            # mutate the dict mid-serialization
+            snapshot = dict(released)
+        handler.send(200, {"ok": True, "session": sid,
+                           "replicas": snapshot})
+
     # -- disaggregated prefill/decode (phase-split) ship ---------------------
 
     def _maybe_ship(self, key: bytes | None, body: dict,
-                    headers: dict) -> None:
+                    headers: dict, sticky: str | None = None) -> None:
         """Phase-split a cold request: run its prefill on a PREFILL-
         class replica (``/v1/kv/export`` — the export IS the prefill)
         and ship the resulting KV blocks to the affinity-chosen DECODE
@@ -488,19 +765,43 @@ class FleetRouter:
         if not decs:
             self.disagg.record_fallback("no_decode_replica")
             return
-        target_name = affinity.pick_replica(
-            key, sorted(r.name for r in decs))
+        # a sticky session's turn forwards to its HOME, which after a
+        # failover is the session-key rendezvous pick, not the prefix-key
+        # one — the ship must land where the forward will actually go
+        target_name = (sticky if sticky is not None
+                       and any(r.name == sticky for r in decs)
+                       else affinity.pick_replica(
+                           key, sorted(r.name for r in decs)))
         dec = next(r for r in decs if r.name == target_name)
         with self._ship_lock:
             seen = self._shipped.setdefault(dec.name, OrderedDict())
-            if key in seen:
+            dedup_hit = key in seen
+            if dedup_hit:
                 seen.move_to_end(key)
+        pulling = False
+        if dedup_hit:
+            # trust-but-verify the dedup cache: an arena reset (engine
+            # failure on the decode replica) or a partial insert leaves
+            # a stale entry claiming KV the replica no longer holds —
+            # without the check every later request on this prefix pays
+            # a silent local re-prefill. A cheap host-only probe
+            # (/v1/kv/probe) decides; when the blocks are gone, PULL
+            # them back through the normal ship legs instead of falling
+            # straight to mixed-mode.
+            if not self._probe_missing(dec, head):
                 self.disagg.count("ship_skips")
                 return
+            pulling = True
+
+        def fall(reason: str) -> None:
+            self.disagg.record_fallback(reason)
+            if pulling:
+                self.disagg.record_fallback("pull_failed")
+
         prefills = [r for r in routable if r.role == PREFILL
                     and not self._breaker_blocked(r)]
         if not prefills:
-            self.disagg.record_fallback("no_prefill_replica")
+            fall("no_prefill_replica")
             return
         pre = min(prefills, key=lambda r: r.outstanding)
         t0 = time.monotonic()
@@ -517,18 +818,17 @@ class FleetRouter:
             if isinstance(e, InjectedFault):
                 # the kv_ship site fires BEFORE any connection opens: a
                 # simulated ship failure says nothing about the replica
-                self.disagg.record_fallback("ship_fault")
+                fall("ship_fault")
             else:
                 if not self._is_timeout(e):
                     self.pool.note_failure(pre)
-                self.disagg.record_fallback("export_failed")
+                fall("export_failed")
             log_event(log, "kv export failed, serving mixed",
                       replica=pre.name, error=str(e))
             return
         if status != 200:
-            self.disagg.record_fallback(
-                "export_shed" if status in (429, 503) else
-                "export_failed")
+            fall("export_shed" if status in (429, 503) else
+                 "export_failed")
             return
         self.disagg.count("prefill_dispatches")
         # import leg: the decode replica registers the shipped blocks
@@ -539,11 +839,11 @@ class FleetRouter:
                 dec, "/v1/kv/import", frame, imp_headers)
         except Exception as e:  # noqa: BLE001 — fall back to mixed
             if isinstance(e, InjectedFault):
-                self.disagg.record_fallback("ship_fault")
+                fall("ship_fault")
             else:
                 if not self._is_timeout(e):
                     self.pool.note_failure(dec)
-                self.disagg.record_fallback("import_failed")
+                fall("import_failed")
             log_event(log, "kv import failed, serving mixed",
                       replica=dec.name, error=str(e))
             return
@@ -552,10 +852,10 @@ class FleetRouter:
             # admission): the priced-shed path — honor it by NOT
             # forcing more KV into the replica; local prefill there is
             # charged through its own admission instead
-            self.disagg.record_fallback("import_backpressure")
+            fall("import_backpressure")
             return
         if istatus != 200:
-            self.disagg.record_fallback("import_failed")
+            fall("import_failed")
             return
         self.disagg.record_ship(nbytes=len(frame),
                                 ms=(time.monotonic() - t0) * 1e3)
@@ -574,6 +874,29 @@ class FleetRouter:
             while len(seen) > self._shipped_cap:
                 seen.popitem(last=False)
         self.disagg.count("decode_dispatches")
+        if pulling:
+            # the dedup entry lied and the pull restored the blocks —
+            # surfaced next to the fallback reasons so an operator sees
+            # arena resets eating shipped KV before it costs latency
+            self.disagg.record_fallback("pull_hit")
+
+    def _probe_missing(self, dec: Replica, head: list) -> bool:
+        """True when the decode replica no longer holds the whole-block
+        head the ship-dedup cache claims it shipped (arena reset
+        flushed it, or the insert was partial). Probe errors read as
+        NOT missing — the pre-pull behavior — so a replica without the
+        probe surface keeps plain dedup semantics."""
+        try:
+            status, _, body = self._forward(
+                dec, "/v1/kv/probe",
+                json.dumps({"tokens": head}).encode(),
+                {"Content-Type": "application/json"})
+            if status != 200:
+                return False
+            matched = int(json.loads(body).get("matched", 0))
+        except Exception:  # noqa: BLE001 — probe is advisory
+            return False
+        return matched < len(head)
 
     # -- request routing ----------------------------------------------------
 
@@ -612,17 +935,23 @@ class FleetRouter:
             # pre-first-byte retries, and an unfunded stream-heavy
             # workload would starve everyone down to the min floor
             self.retry_budget.record_request()
+        # sticky sessions: resolve the home replica BEFORE the ship and
+        # the pick — a failover (dead home) re-homes and re-ships here
+        sid = self._session_id(handler.headers, body)
+        sticky = self._session_sticky(sid, body) if sid else None
         # phase-split dispatch (no-op without prefill-class replicas):
         # prefill on a prefill replica, KV blocks shipped to the decode
         # target, BEFORE the forward — streams included (the ship
         # happens before any response bytes exist)
-        self._maybe_ship(key, body, headers)
+        self._maybe_ship(key, body, headers, sticky=sticky)
         if body.get("stream"):
-            self._route_stream(handler, path, raw, headers, key)
+            self._route_stream(handler, path, raw, headers, key,
+                               sid=sid, sticky=sticky, body=body)
             return
         t0 = time.monotonic()
         res = self._attempt(handler, path, raw, headers, key, t0,
-                            count_affinity=True)
+                            count_affinity=True, sid=sid,
+                            sticky=sticky, body=body)
         if res is None:
             return  # response already on the wire
         # the fleet is exhausted (every attempt shed, or nothing was
@@ -651,7 +980,8 @@ class FleetRouter:
                 self.stats.count("spill_drained")
                 try:
                     res = self._attempt(handler, path, raw, headers, key,
-                                        t0, count_affinity=False)
+                                        t0, count_affinity=False,
+                                        sid=sid, sticky=sticky, body=body)
                 finally:
                     self.spill.done(outcome)
                 if res is None:
@@ -686,17 +1016,23 @@ class FleetRouter:
         handler.send(shed.code, payload, hdrs)
 
     def _attempt(self, handler, path: str, raw: bytes, headers: dict,
-                 key: bytes | None, t0: float, *, count_affinity: bool):
+                 key: bytes | None, t0: float, *, count_affinity: bool,
+                 sid: str | None = None, sticky: str | None = None,
+                 body: dict | None = None):
         """One retry round over the fleet. Returns None when a response
         was sent to the client, the last shed ``(status, hdrs, body)``
         tuple when every attempt shed, or ``"no_replica"`` when nothing
-        was routable."""
+        was routable. ``sticky`` is the session home the first pick
+        prefers; whichever replica actually serves is recorded as the
+        session's home."""
         tried: set = set()
         last_shed: tuple | None = None
         attempt = 0
         first = count_affinity
         while attempt <= self.max_retries:
-            r = self._pick(key, tried, count_affinity=first)
+            r = self._pick(key, tried, count_affinity=first,
+                           prefer=(sticky if sticky is not None
+                                   and sticky not in tried else None))
             if r is None:
                 break
             hedge_s = self._hedge_threshold_s() if first else None
@@ -757,6 +1093,10 @@ class FleetRouter:
                 self.pool.bump(r, "errors")
                 self.stats.count("errors")
             else:
+                # the replica that SERVED becomes (or stays) the
+                # session's home — first turns create the record,
+                # retry outcomes self-heal it
+                self._note_session_home(sid, r.name, body or {}, key)
                 self.stats.count("completed")
                 self.stats.latency.record((time.monotonic() - t0) * 1e3)
             handler.relay(status, hdrs, out)
@@ -822,7 +1162,9 @@ class FleetRouter:
         return rep, out
 
     def _route_stream(self, handler, path: str, raw: bytes,
-                      headers: dict, key: bytes | None) -> None:
+                      headers: dict, key: bytes | None, *,
+                      sid: str | None = None, sticky: str | None = None,
+                      body: dict | None = None) -> None:
         """Streamed pass-through: retry replicas until a response OPENS,
         then relay line-frames; once bytes are on the wire the stream is
         committed to that replica."""
@@ -831,7 +1173,9 @@ class FleetRouter:
         last_shed: tuple | None = None
         first = True
         for attempt in range(self.max_retries + 1):
-            r = self._pick(key, tried, count_affinity=first)
+            r = self._pick(key, tried, count_affinity=first,
+                           prefer=(sticky if sticky is not None
+                                   and sticky not in tried else None))
             first = False
             if r is None:
                 break
@@ -898,6 +1242,9 @@ class FleetRouter:
                         break
                     continue
                 self.pool.bump(r, "routed")
+                # the stream is committed to this replica from here on:
+                # it IS the session's home for subsequent turns
+                self._note_session_home(sid, r.name, body or {}, key)
                 handler.send_response(200)
                 handler.send_header(
                     "Content-Type",
@@ -1037,6 +1384,12 @@ class FleetRouter:
                 },
                 "spec_standdown": {"total": sd_total,
                                    "reasons": sd_reasons},
+                # sticky multi-turn sessions: open records + sticky/
+                # failover/re-ship counters
+                "sessions": {
+                    **self.sessions.report(),
+                    "active": len(self._session_map),
+                },
                 # phase-split serving: router-side dispatch/ship/EWMA
                 # counters + per-class membership + the replica-side
                 # export/import aggregate
@@ -1134,6 +1487,7 @@ class FleetRouter:
                         **({"wedged": wedged} if wedged else {}),
                         **({"spill_depth": router_self.spill.depth()}
                            if router_self.spill is not None else {}),
+                        "sessions": len(router_self._session_map),
                         "affinity": router_self.affinity_on,
                         "block": router_self.block,
                     })
@@ -1141,6 +1495,14 @@ class FleetRouter:
                     self.send(200, router_self.metrics())
                 else:
                     self.send(404, {"ok": False, "error": "not found"})
+
+            def do_DELETE(self):
+                if self.path.startswith("/v1/sessions/"):
+                    sid = self.path[len("/v1/sessions/"):]
+                    if sid:
+                        router_self._end_session(sid, self)
+                        return
+                self.send(404, {"ok": False, "error": "not found"})
 
             def do_POST(self):
                 if self.path not in _ROUTED_PATHS:

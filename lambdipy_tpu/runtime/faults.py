@@ -25,6 +25,14 @@ site                      where it fires
 ``probe``                 the replica pool's per-replica health probe
 ``kv_ship``               the router's prefill→decode KV-block ship (fires
                           once per ship attempt, before the export leg)
+``session_pin``           the prefix store pinning a session's radix head
+                          (fires once per turn, before any pin mutation;
+                          an exception fails the pin OPEN — the turn
+                          serves unpinned, counted)
+``session_failover``      the router re-homing a session off a dead/
+                          drained replica (fires before the re-ship legs;
+                          an exception skips the re-ship — the new home
+                          re-prefills locally, counted)
 ========================  ====================================================
 
 The ``route_*``/``probe`` sites live in the FLEET layer (fleet/router.py
@@ -67,7 +75,7 @@ SITES = ("segment_dispatch", "segment_fetch", "group_prefill",
          "prefix_assemble", "prefix_walk", "transport", "page_alloc",
          # fleet-layer (router/pool) network sites
          "route_connect", "route_body", "route_latency", "probe",
-         "kv_ship")
+         "kv_ship", "session_pin", "session_failover")
 KINDS = ("exception", "delay", "hang")
 _KIND_ALIASES = {"error": "exception", "raise": "exception",
                  "sleep": "delay", "stall": "delay", "block": "hang"}

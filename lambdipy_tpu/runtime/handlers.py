@@ -54,6 +54,15 @@ class HandlerState:
     # no prefix store, /v1/kv/* answers 404.
     kv_export_fn: Callable[[dict], Any] | None = None
     kv_import_fn: Callable[[bytes], dict] | None = None
+    # optional host-only KV presence probe ({"tokens": [...]} ->
+    # {"matched": n}): the router's import-miss PULL checks it before
+    # trusting a ship-dedup entry (an arena reset may have flushed the
+    # blocks the dedup cache still claims are there). O(depth) tree
+    # walk, no device work.
+    kv_probe_fn: Callable[[dict], dict] | None = None
+    # optional session close (DELETE /v1/sessions/{id} -> release the
+    # session's prefix-store pins now instead of waiting out the lease)
+    session_end_fn: Callable[[str], dict] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
@@ -606,11 +615,31 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # is the ONE resolved block width the page pool sized by.
             paged_pool = (continuous.pool if continuous is not None
                           else None)
+            # session-pin knobs (multi-turn chat): the pin budget caps
+            # total bytes open sessions may hold out of eviction's
+            # reach; ttl/idle are the lease (renewed every turn). Env
+            # first like the cache-mb knob — `lambdipy serve
+            # --session-pin-budget/--session-ttl` bridge through it.
+            raw_pb = _os_px.environ.get("LAMBDIPY_SESSION_PIN_BUDGET_MB")
+            if raw_pb in (None, ""):
+                raw_pb = extra.get("session_pin_budget_mb")
+            raw_ttl = _os_px.environ.get("LAMBDIPY_SESSION_TTL_S")
+            if raw_ttl in (None, ""):
+                raw_ttl = extra.get("session_ttl_s")
+            raw_idle = _os_px.environ.get("LAMBDIPY_SESSION_IDLE_S")
+            if raw_idle in (None, ""):
+                raw_idle = extra.get("session_idle_s")
             prefix_store = PrefixStore(
                 server, block=prefix_block, budget_mb=mb,
                 pool=paged_pool,
                 faults=(continuous.faults if continuous is not None
-                        else None))
+                        else None),
+                pin_budget_mb=(float(raw_pb)
+                               if raw_pb not in (None, "") else None),
+                session_ttl_s=(float(raw_ttl)
+                               if raw_ttl not in (None, "") else 3600.0),
+                session_idle_s=(float(raw_idle)
+                                if raw_idle not in (None, "") else 600.0))
             if paged_pool is not None:
                 continuous.prefix_pages_fn = prefix_store.acquire_pages
 
@@ -621,7 +650,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     # under --kv-paged. Rides the prefix store, so it exists exactly
     # when automatic prefix caching does.
     kv_ship_stats = None
-    kv_export = kv_import = None
+    kv_export = kv_import = kv_probe = None
     if prefix_store is not None:
         from lambdipy_tpu.runtime.kvwire import decode_frame, encode_frame
         from lambdipy_tpu.runtime.metrics import KvShipStats
@@ -669,6 +698,20 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 inserted=res["inserted"], present=res["present"],
                 mode=res["mode"])
             return {"ok": True, **res}
+
+        def kv_probe(req: dict) -> dict:
+            """{"tokens": [...]} -> how many head tokens are actually
+            PRESENT in the radix tree (host-only; no device work). The
+            router's import-miss pull calls this before trusting its
+            ship-dedup cache."""
+            raw = req.get("tokens")
+            if not isinstance(raw, (list, tuple)) or not raw or \
+                    not all(isinstance(t, int) for t in raw):
+                return {"ok": False,
+                        "error": "kv probe wants a flat token id list"}
+            return {"ok": True,
+                    "matched": prefix_store.present_len(list(raw)),
+                    "block": prefix_store.block}
 
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a
@@ -765,35 +808,57 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         except Exception as e:  # noqa: BLE001 - degrade, recorded in meta
             tok_err = str(e)
 
-    def _route_prefix(prompt, prefix):
+    def _route_prefix(prompt, prefix, sess=None):
         """Transparent radix reuse: split a single-row prompt into
         (suffix prompt, cached-prefix tokens) when the prefix store can
         match or extend a block-aligned prefix. Requests carrying an
         EXPLICIT ``prefix`` keep the client's split; multi-row and
         sub-block prompts pass through. Fail-open by construction —
-        ``route`` returns 0 on any store failure."""
+        ``route`` returns 0 on any store failure.
+
+        ``sess`` = (session_id, ttl_s | None): after routing, the
+        conversation's whole-block head is PINNED in the store under
+        the session's lease, so turn-2+ requests keep hitting even
+        under LRU pressure. A pin-budget refusal raises
+        :class:`SessionPinsExceeded` (the server maps it to the priced
+        ``session_pins`` 503); any routing stand-down just renews the
+        lease without pinning — sessions degrade with the cache, never
+        ahead of it."""
         if prefix_store is None or prefix is not None or len(prompt) != 1:
             return prompt, prefix
+        standdown = False
         if continuous is not None and continuous.degrade_level >= 3:
             # degradation ladder level 3: a repeatedly-failing engine
             # bypasses the prefix cache — full-prompt prefill through
-            # the plainest path until a clean interval restores it
-            return prompt, prefix
+            # the plainest path until a clean interval restores it.
+            # Session pins SURVIVE the bypass untouched (only the lease
+            # renews): when the ladder restores, the next turn hits the
+            # still-pinned head.
+            standdown = True
         if continuous is not None and \
                 continuous.cache_len != server.model.cfg.max_len:
             # a capped engine can't pack full-window prefix carries
             # (continuous._admit falls back solo): auto-routing would
             # silently trade away continuous batching for KV reuse —
             # keep the engine's pre-cache behavior and skip routing
-            return prompt, prefix
+            standdown = True
         if batcher is not None and continuous is None:
             # MicroBatcher mode: prefix requests bypass the window
             # batcher entirely (it has no prefix path), so routing would
             # serialize exactly the concurrent traffic the batcher
             # fuses — same silent-trade regression, same stand-down
+            standdown = True
+        if standdown:
+            if sess is not None and sess[0]:
+                prefix_store.touch_session(str(sess[0]))
             return prompt, prefix
         row = [int(t) for t in np.asarray(prompt[0]).reshape(-1)]
         m = prefix_store.route(row)
+        if sess is not None and sess[0]:
+            # pin AFTER route: the head's blocks exist now (the
+            # request's own prefill inserted them). SessionPinsExceeded
+            # propagates — the HTTP layer sheds the session, priced.
+            prefix_store.pin_session(str(sess[0]), row, ttl_s=sess[1])
         if m <= 0:
             return prompt, prefix
         return ([np.asarray(row[m:], np.int32)],
@@ -927,8 +992,21 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             if len(prompt) != 1:
                 return {"ok": False, "error":
                         "speculative decoding is single-row"}
+        # multi-turn session surface: `session_id` (string/number) pins
+        # the conversation's prefix KV under a lease; `session_ttl_s`
+        # optionally tightens this session's idle lease (clamped)
+        sess = None
+        sid = req.get("session_id")
+        if sid is not None and str(sid):
+            try:
+                ttl = (float(req["session_ttl_s"])
+                       if req.get("session_ttl_s") is not None else None)
+            except (TypeError, ValueError):
+                return {"ok": False,
+                        "error": "session_ttl_s must be a number"}
+            sess = (str(sid), ttl)
         return (prompt, max_new, sample_kwargs, from_text, prefix,
-                bool(req.get("logprobs")), spec_k)
+                bool(req.get("logprobs")), spec_k, sess)
 
     def invoke(req: dict) -> dict:
         parsed = _parse(req)
@@ -953,8 +1031,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
 
     def _invoke_parsed(parsed) -> dict:
         (prompt, max_new, sample_kwargs, from_text, prefix, want_lp,
-         spec_k) = parsed
-        prompt, prefix = _route_prefix(prompt, prefix)
+         spec_k, sess) = parsed
+        prompt, prefix = _route_prefix(prompt, prefix, sess)
         lps = None
         if want_lp and server is None:
             return {"ok": False,
@@ -1026,8 +1104,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             yield parsed
             return
         (prompt, max_new, sample_kwargs, from_text, prefix, want_lp,
-         spec_k) = parsed
-        prompt, prefix = _route_prefix(prompt, prefix)
+         spec_k, sess) = parsed
+        prompt, prefix = _route_prefix(prompt, prefix, sess)
         # clamp the client's segment size to a pow-2 in [4, 64]: it is
         # part of the compiled-program key, and an arbitrary per-request
         # value would grow the program cache (and pay a compile) without
@@ -1181,6 +1259,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                          if continuous is not None else None),
         kv_export_fn=kv_export,
         kv_import_fn=kv_import,
+        kv_probe_fn=kv_probe,
+        session_end_fn=(prefix_store.end_session
+                        if prefix_store is not None else None),
         meta={
             "model": spec["model"], "quant": spec.get("quant"),
             "sharded": mesh is not None,
@@ -1190,6 +1271,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             "compile_once": server is not None,
             "streaming": server is not None,
             "prefix_cache": prefix_store is not None,
+            "sessions": prefix_store is not None,
             "kv_ship": prefix_store is not None,
             "kv_paged": (continuous is not None
                          and continuous.pool is not None),

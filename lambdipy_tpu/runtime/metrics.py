@@ -668,6 +668,58 @@ class DisaggStats:
 
 
 @dataclass
+class SessionStats:
+    """Router-side counters for sticky multi-turn sessions — the
+    ``fleet.sessions`` block on the fleet ``/metrics``.
+
+    ``opened`` counts session ids first seen; ``sticky_hits`` turns that
+    landed on their recorded home replica, ``sticky_misses`` pick
+    attempts whose preferred home was unusable at pick time — a
+    saturation spill (the home past the outstanding threshold), or the
+    home vanishing between the sticky check and the pick. The turn
+    still serves and re-homes; under retries/spill a single turn can
+    count more than one miss, so hits/misses are attempt-level, not
+    turn-level.
+    ``failovers`` counts re-homings off a dead/drained home; ``reships``
+    the subset whose whole-block KV head was successfully re-shipped to
+    the new home (export from the old home → import on the new one), and
+    ``reship_fallbacks`` keys the rest by reason — the common SIGKILL
+    case is ``old_home_unreachable``: the KV died with the worker, so
+    the new home's counted local re-prefill IS the recovery path.
+    ``deletes`` counts explicit ``DELETE /v1/sessions/{id}`` closes."""
+
+    opened: int = 0
+    sticky_hits: int = 0
+    sticky_misses: int = 0
+    failovers: int = 0
+    reships: int = 0
+    deletes: int = 0
+    reship_fallbacks: dict = field(default_factory=dict)  # reason -> n
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def count(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def record_fallback(self, reason: str) -> None:
+        with self._lock:
+            self.reship_fallbacks[str(reason)] = \
+                self.reship_fallbacks.get(str(reason), 0) + 1
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "opened": self.opened,
+                "sticky_hits": self.sticky_hits,
+                "sticky_misses": self.sticky_misses,
+                "failovers": self.failovers,
+                "reships": self.reships,
+                "deletes": self.deletes,
+                "reship_fallbacks": dict(self.reship_fallbacks),
+            }
+
+
+@dataclass
 class RouterStats:
     """Counters for the fleet front-door (fleet/router.py), exported on
     the router's ``/metrics`` under ``router``. ``retries`` counts

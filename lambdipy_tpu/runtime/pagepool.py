@@ -119,6 +119,12 @@ class PagePool:
         # comes up short, so store-owned cold pages yield to admission
         # instead of starving it (lock order stays store -> pool)
         self.reclaim_fn: Callable[[int], int] | None = None
+        # optional session-pin gauge provider (the prefix store's
+        # _pool_pin_gauges): merged into stats() OUTSIDE the pool lock
+        # (the provider takes the store lock; store -> pool is the one
+        # sanctioned order) so operators see pinned pages squeezing
+        # arena headroom next to the refcount gauges
+        self.pinned_fn: Callable[[], dict] | None = None
         self.stats_counters = PagePoolStats()
         self._lock = threading.RLock()
         # serializes the functional-arena chain (see module docstring);
@@ -330,6 +336,11 @@ class PagePool:
                 "retry_after_s": round(self.retry_after_s(), 3),
             }
         out.update(self.stats_counters.report())
+        if self.pinned_fn is not None:
+            try:
+                out.update(self.pinned_fn())
+            except Exception:  # noqa: BLE001 — gauges must never break stats
+                pass
         return out
 
     def check_invariants(self) -> None:

@@ -53,6 +53,25 @@ the same chunk programs into a transient contiguous cache and write each
 new block into its own page; eviction is refcount-aware (only leaves no
 live row shares may release their page).
 
+SESSION PINS (multi-turn chat): a session id attached to a request PINS
+the conversation's radix path — pinned nodes are excluded from the LRU
+budget sweep AND from the refcount-aware cold-page reclaim
+(``reclaim_fn``), so an open conversation's KV cannot vanish under cache
+pressure mid-conversation and every turn-2+ request longest-prefix-
+matches its whole history. Pins are LEASES, not locks: each carries an
+absolute TTL (from session creation) and an idle timeout renewed on
+every turn, and expired sessions release lazily on the next locked store
+operation (``stats()`` included, so a scrape is enough to converge
+accounting to zero). Total pinned bytes are capped by
+``pin_budget_mb`` — a pin that would exceed it raises
+:class:`SessionPinsExceeded`, which the HTTP layer maps to a priced 503
+shed (reason ``session_pins``) with Retry-After taken from the earliest
+lease-expiry horizon: pins can never starve live traffic, they can only
+shed new sessions. An arena-generation bump (engine failure reset)
+invalidates every pin observably (``pin_invalidations``): the sessions
+drop with the stale tree and the next turn re-prefills through the
+normal walk — a counted, bounded re-prefill, never a wedge.
+
 Every failure path FAILS OPEN: a store error logs and the request serves
 unrouted — the cache is an optimization, never an availability risk.
 """
@@ -61,6 +80,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Any
 
 from lambdipy_tpu.runtime.metrics import PrefixCacheStats
@@ -69,14 +89,50 @@ from lambdipy_tpu.utils.logs import get_logger
 log = get_logger("lambdipy.prefixstore")
 
 
+class SessionPinsExceeded(RuntimeError):
+    """Pinning this session's head would push total pinned bytes past
+    ``pin_budget_mb``. Mapped by the HTTP layer to a priced 503 shed
+    (reason ``session_pins``); ``retry_after_s`` is the earliest
+    lease-expiry horizon — when the next pinned session can lapse and
+    free budget."""
+
+    def __init__(self, needed: int, budget: int, retry_after_s: float):
+        self.needed = int(needed)
+        self.budget = int(budget)
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            f"session pin budget exhausted: pinning needs {needed} more "
+            f"bytes of a {budget}-byte budget (retry in "
+            f"~{self.retry_after_s:.1f}s)")
+
+
+class _Session:
+    """One live conversation's pin lease: the pinned path nodes, the
+    idle-renewed expiry, the absolute deadline (created + ttl), and the
+    EFFECTIVE idle window (the store default, tightened by the client's
+    own ``session_ttl_s`` — renewals must honor the tightened value,
+    never silently expand it back to the default)."""
+
+    __slots__ = ("nodes", "expires", "deadline", "idle", "turns")
+
+    def __init__(self, deadline: float, idle: float):
+        self.nodes: list = []
+        self.expires = 0.0
+        self.deadline = deadline
+        self.idle = idle
+        self.turns = 0
+
+
 class _Node:
     """One block of a cached prefix: ``kv`` is the per-layer store-layout
     slice list for this block's absolute positions (dense mode), or
     ``page_id`` names the arena page holding them (paged mode — the
-    store owns one pool ref per node)."""
+    store owns one pool ref per node). ``pins`` counts live sessions
+    holding this node: a pinned node is excluded from every eviction
+    sweep."""
 
     __slots__ = ("parent", "token_key", "children", "kv", "nbytes",
-                 "last_used", "page_id")
+                 "last_used", "page_id", "pins")
 
     def __init__(self, parent, token_key, kv=None, nbytes=0,
                  page_id=None):
@@ -87,6 +143,7 @@ class _Node:
         self.nbytes = nbytes
         self.last_used = 0
         self.page_id = page_id
+        self.pins = 0
 
 
 def _slices_bytes(slices) -> int:
@@ -108,7 +165,9 @@ class PrefixStore:
 
     def __init__(self, server: Any, *, block: int = 32,
                  budget_mb: float = 512.0, pool: Any = None,
-                 faults: Any = None):
+                 faults: Any = None, pin_budget_mb: float | None = None,
+                 session_ttl_s: float = 3600.0,
+                 session_idle_s: float = 600.0):
         from lambdipy_tpu.runtime.pagepool import page_width
 
         self.server = server
@@ -173,6 +232,34 @@ class PrefixStore:
         # target-path key -> Event: concurrent cold requests for the same
         # prefix wait for one device walk instead of duplicating it
         self._inflight: dict[str, threading.Event] = {}
+        # -- session pins (multi-turn chat) --------------------------------
+        # default pin budget: half the store budget, so a fully pinned
+        # session population still leaves LRU headroom for ordinary
+        # shared-prefix traffic. An explicit budget is CLAMPED to the
+        # cache budget: pinned bytes live inside the store's accounting,
+        # and a pin budget above it would let sessions hold the whole
+        # cache (or, paged, the whole arena) out of eviction's reach —
+        # exactly the live-traffic starvation pins must never cause.
+        self.pin_budget_bytes = int(
+            min(float(pin_budget_mb) * 2**20, self.budget_bytes)
+            if pin_budget_mb is not None
+            else self.budget_bytes // 2)
+        self.session_ttl_s = max(1.0, float(session_ttl_s))
+        self.session_idle_s = max(1.0, float(session_idle_s))
+        self._sessions: dict[str, _Session] = {}
+        self._pinned_bytes = 0
+        self._pinned_leaves = 0
+        self.pin_sheds = 0          # NEW sessions refused on budget (503)
+        self.pin_overflows = 0      # renewals that could not extend
+        self.pin_expiries = 0       # sessions lapsed by TTL/idle lease
+        self.pin_invalidations = 0  # sessions dropped by an arena reset
+        self.pin_faults = 0         # injected session_pin faults (open)
+        if pool is not None:
+            # pinned-page gauges ride batching.page_pool too, so an
+            # operator sizing the arena sees pins squeezing headroom
+            # next to the refcount gauges (host-only, store lock only —
+            # the pool calls this OUTSIDE its own lock)
+            pool.pinned_fn = self._pool_pin_gauges
 
     # -- host-side matching --------------------------------------------------
 
@@ -210,6 +297,18 @@ class PrefixStore:
                 self.pool.release([node.page_id])
                 self.stats_counters.record_evict(1, node.nbytes)
                 node.page_id = None
+            node.pins = 0
+        # session pins die with the stale tree — OBSERVABLY: the next
+        # turn re-prefills its whole head through the normal walk (a
+        # counted, bounded recovery) and re-pins fresh nodes
+        if self._sessions:
+            dropped = len(self._sessions)
+            self.pin_invalidations += dropped
+            self._sessions.clear()
+            log.info("arena reset invalidated %d session pin lease(s)",
+                     dropped)
+        self._pinned_bytes = 0
+        self._pinned_leaves = 0
         self._root.children = {}
         log.info("prefix store flushed: arena generation moved "
                  "(engine failure reset the page arena)")
@@ -307,6 +406,186 @@ class PrefixStore:
                 m += self.block
             self.pool.retain(pids)
         return pids, m
+
+    # -- session pins (multi-turn chat) ---------------------------------------
+
+    def _unpin_locked(self, nodes) -> None:
+        for n in nodes:
+            if n.pins <= 0:
+                continue  # already cleared by an arena flush
+            n.pins -= 1
+            if n.pins == 0:
+                self._pinned_bytes -= n.nbytes
+                self._pinned_leaves -= 1
+
+    def _expire_sessions_locked(self, now: float) -> None:
+        """Lazily lapse sessions past their idle lease or absolute TTL —
+        called from every pin/stats path, so a /metrics scrape alone is
+        enough to converge pin accounting after sessions go quiet."""
+        for sid in [s for s, sess in self._sessions.items()
+                    if now >= sess.expires or now >= sess.deadline]:
+            self._unpin_locked(self._sessions.pop(sid).nodes)
+            self.pin_expiries += 1
+            log.info("session %s lease expired: pins released", sid[:16])
+
+    def _lease_horizon_locked(self, now: float) -> float:
+        """Seconds until the next pinned session CAN lapse — the honest
+        Retry-After for a budget shed (a freed budget needs a lease to
+        end, not wall-clock optimism)."""
+        horizon = [min(s.expires, s.deadline) - now
+                   for s in self._sessions.values()]
+        return max(1.0, min(horizon)) if horizon else 1.0
+
+    def pin_session(self, session_id: str, tokens, *,
+                    ttl_s: float | None = None) -> int:
+        """Pin (or renew) ``session_id`` on the whole-block head of
+        ``tokens`` — call AFTER :meth:`route` so the head's blocks exist.
+        Pinned nodes are excluded from the LRU budget sweep and the
+        cold-page reclaim until the session ends (:meth:`end_session`),
+        its lease lapses, or an arena reset invalidates the tree. Each
+        turn re-pins the (longer) head and renews the idle lease;
+        ``ttl_s`` optionally TIGHTENS the idle lease for this session
+        (clamped to the configured ``session_idle_s`` — a client may ask
+        for less retention, never more; once tightened it sticks for the
+        session's lifetime). Returns the pinned token count.
+
+        Budget overflow splits by session age: a NEW session the budget
+        cannot hold raises :class:`SessionPinsExceeded` (nothing
+        mutated — the HTTP layer sheds the turn 503 and the client
+        retries after the lease horizon), while an EXISTING
+        conversation whose head outgrew the budget keeps the pins it
+        already holds, renews its lease, and serves (``pin_overflows``
+        counts it) — a mid-conversation turn must never become
+        permanently unservable over a retention optimization."""
+        if self.faults is not None:
+            try:
+                self.faults.check("session_pin")
+            except Exception as e:  # noqa: BLE001 — injected: fail OPEN
+                with self._lock:
+                    self.pin_faults += 1
+                log.error("session pin failed open (turn serves "
+                          "unpinned): %s", e)
+                return 0
+        try:
+            row = [int(t) for t in tokens]
+        except (TypeError, ValueError):
+            return 0
+        sid = str(session_id)
+        cfg = self.server.model.cfg
+        target = min(self._target_len(len(row)),
+                     cfg.max_len - self.block)
+        idle = self.session_idle_s
+        if ttl_s is not None and float(ttl_s) > 0:
+            idle = min(idle, float(ttl_s))
+        now = time.monotonic()
+        with self._lock:
+            self._maybe_flush_stale_locked()
+            self._expire_sessions_locked(now)
+            path: list = []
+            if target > 0:
+                _, path = self._present_locked(row[:target])
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                # a tightened per-request lease sticks for the session's
+                # lifetime (clients ask for LESS retention, never more)
+                # — applied BEFORE any overflow early-return, so a
+                # tightening sent while the budget is full still lands
+                sess.idle = min(sess.idle, idle)
+            held = set(id(n) for n in sess.nodes) if sess else set()
+            fresh = [n for n in path
+                     if n.pins == 0 and id(n) not in held]
+            need = sum(n.nbytes for n in fresh)
+            if self._pinned_bytes + need > self.pin_budget_bytes:
+                if sess is None:
+                    # a NEW session the budget cannot hold: the priced
+                    # shed — new sessions queue behind lease turnover
+                    self.pin_sheds += 1
+                    raise SessionPinsExceeded(
+                        self._pinned_bytes + need
+                        - self.pin_budget_bytes,
+                        self.pin_budget_bytes,
+                        self._lease_horizon_locked(now))
+                # an EXISTING conversation whose head outgrew the
+                # budget: keep the pins it already holds and renew the
+                # lease — the turn serves with partial (or stale-depth)
+                # pinning rather than the session becoming permanently
+                # unservable (a pin is retention, never admission)
+                self.pin_overflows += 1
+                sess.expires = now + sess.idle
+                sess.turns += 1
+                return len(sess.nodes) * self.block
+            if sess is None:
+                sess = _Session(deadline=now + self.session_ttl_s,
+                                idle=idle)
+                self._sessions[sid] = sess
+            for n in path:
+                if id(n) not in held:
+                    n.pins += 1
+                    if n.pins == 1:
+                        self._pinned_bytes += n.nbytes
+                        self._pinned_leaves += 1
+            # a turn's prompt extends the previous head, so stale nodes
+            # only exist when the client changed conversations under one
+            # id — unpin them rather than leak the lease
+            new_ids = set(id(n) for n in path)
+            self._unpin_locked([n for n in sess.nodes
+                                if id(n) not in new_ids])
+            sess.nodes = path
+            sess.expires = now + sess.idle
+            sess.turns += 1
+            return len(path) * self.block
+
+    def touch_session(self, session_id: str) -> bool:
+        """Renew a session's idle lease without re-walking its head
+        (sub-block turns, degraded routing). Honors the session's own
+        (possibly client-tightened) idle window. False = unknown or
+        already lapsed."""
+        now = time.monotonic()
+        with self._lock:
+            self._expire_sessions_locked(now)
+            sess = self._sessions.get(str(session_id))
+            if sess is None:
+                return False
+            sess.expires = now + sess.idle
+            return True
+
+    def end_session(self, session_id: str) -> dict:
+        """Explicit close (``DELETE /v1/sessions/{id}``): release the
+        session's pins now instead of waiting out the lease."""
+        with self._lock:
+            self._expire_sessions_locked(time.monotonic())
+            sess = self._sessions.pop(str(session_id), None)
+            if sess is None:
+                return {"released": False, "pinned_leaves": 0}
+            n = len(sess.nodes)
+            self._unpin_locked(sess.nodes)
+            return {"released": True, "pinned_leaves": n}
+
+    def present_len(self, tokens) -> int:
+        """Host-only: tokens of the whole-block head actually PRESENT
+        (dense kv or live paged page) — the ``/v1/kv/probe`` surface the
+        router's import-miss pull checks before trusting its ship-dedup
+        cache."""
+        try:
+            row = [int(t) for t in tokens]
+        except (TypeError, ValueError):
+            return 0
+        head = row[:(len(row) // self.block) * self.block]
+        if not head:
+            return 0
+        with self._lock:
+            self._maybe_flush_stale_locked()
+            return self._present_locked(head)[0]
+
+    def _pool_pin_gauges(self) -> dict:
+        """batching.page_pool's view of session pins (paged mode): each
+        pinned leaf holds exactly one arena page the reclaim sweep may
+        not touch."""
+        with self._lock:
+            return {"pinned_pages": self._pinned_leaves,
+                    "pinned_bytes": self._pinned_bytes,
+                    "pin_budget_bytes": self.pin_budget_bytes,
+                    "pin_sheds": self.pin_sheds}
 
     # -- KV export / import (disaggregated prefill/decode) --------------------
 
@@ -776,8 +1055,12 @@ class PrefixStore:
         sweep (pressure recurs; convergence does not need cascading
         here)."""
         refs = self.pool.snapshot_refs()
+        # pinned leaves are invisible to the sweep: an open session's
+        # conversation KV must survive cache pressure — that retention
+        # is bounded by the PIN budget, not the LRU budget
         leaves = [node for node in self._iter_nodes()
                   if not node.children and node.page_id is not None
+                  and not node.pins
                   and refs.get(node.page_id, 0) == 1]
         leaves.sort(key=lambda node: node.last_used)
         freed = 0
@@ -808,7 +1091,8 @@ class PrefixStore:
                     return
         while self.stats_counters.report()["bytes"] > self.budget_bytes:
             leaves = [n for n in self._iter_nodes()
-                      if not n.children and n.kv is not None]
+                      if not n.children and n.kv is not None
+                      and not n.pins]
             if not leaves:
                 return
             victim = min(leaves, key=lambda n: n.last_used)
@@ -829,6 +1113,21 @@ class PrefixStore:
         out = self.stats_counters.report()
         out["block"] = self.block
         out["budget_bytes"] = self.budget_bytes
+        # session-pin surface: the scrape itself runs the lazy lease
+        # sweep, so "pins return to zero after every session closes" is
+        # observable without traffic
+        with self._lock:
+            self._maybe_flush_stale_locked()
+            self._expire_sessions_locked(time.monotonic())
+            out["sessions_active"] = len(self._sessions)
+            out["pinned_leaves"] = self._pinned_leaves
+            out["pinned_bytes"] = self._pinned_bytes
+            out["pin_budget_bytes"] = self.pin_budget_bytes
+            out["pin_sheds"] = self.pin_sheds
+            out["pin_overflows"] = self.pin_overflows
+            out["pin_expiries"] = self.pin_expiries
+            out["pin_invalidations"] = self.pin_invalidations
+            out["pin_faults"] = self.pin_faults
         if self.pool is not None:
             # paged mode: block bytes above are arena pages the store
             # holds a ref on; shares/refcounts live in the pool's own

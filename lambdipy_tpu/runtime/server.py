@@ -35,6 +35,7 @@ from pathlib import Path
 from lambdipy_tpu.runtime.continuous import RequestCancelled
 from lambdipy_tpu.runtime.loader import BootReport, load_bundle
 from lambdipy_tpu.runtime.pagepool import PagesExhausted
+from lambdipy_tpu.runtime.prefixstore import SessionPinsExceeded
 from lambdipy_tpu.runtime.metrics import LatencyStats
 from lambdipy_tpu.sched import (
     SchedConfig,
@@ -115,7 +116,7 @@ def _openai_to_internal(req: dict) -> tuple[dict, str | None]:
     except (TypeError, ValueError) as e:
         return {}, f"max_tokens/temperature/top_p must be numbers: {e}"
     for knob in ("top_k", "seed", "eos_id", "prefix", "segment",
-                 "speculative"):
+                 "speculative", "session_id", "session_ttl_s"):
         if req.get(knob) is not None:
             internal[knob] = req[knob]
     lp = req.get("logprobs")
@@ -406,6 +407,42 @@ class BundleServer:
                 with server_self._inflight_lock:
                     server_self._inflight -= 1
 
+            def _session_header(self, request: dict | None) -> None:
+                """`x-session-id` (+ optional `x-session-ttl-s`) are the
+                header spelling of the body's session fields — the body
+                wins when both are present (explicit beats transport)."""
+                if not isinstance(request, dict):
+                    return
+                sid = self.headers.get("x-session-id")
+                if sid and not request.get("session_id"):
+                    request["session_id"] = sid
+                ttl = self.headers.get("x-session-ttl-s")
+                if ttl and request.get("session_ttl_s") is None:
+                    request["session_ttl_s"] = ttl
+
+            def do_DELETE(self):
+                """DELETE /v1/sessions/{id}: release the session's
+                prefix-store pins NOW (lease expiry would get there
+                eventually; a well-behaved client closes explicitly)."""
+                if not self.path.startswith("/v1/sessions/"):
+                    self._send(404, {"ok": False, "error": "not found"})
+                    return
+                sid = self.path[len("/v1/sessions/"):]
+                fn = getattr(server_self.boot.state, "session_end_fn",
+                             None)
+                if fn is None or not sid:
+                    self._send(404, {"ok": False, "error":
+                                     "no session surface (prefix cache "
+                                     "off or unsupported handler)"})
+                    return
+                try:
+                    out = fn(sid)
+                except Exception as e:  # noqa: BLE001
+                    server_self.stats.record_error()
+                    self._send(500, {"ok": False, "error": str(e)})
+                    return
+                self._send(200, {"ok": True, "session": sid, **out})
+
             def do_POST(self):
                 if self.path == "/v1/completions":
                     self._openai_completions()
@@ -415,6 +452,9 @@ class BundleServer:
                     return
                 if self.path == "/v1/kv/import":
                     self._kv_import()
+                    return
+                if self.path == "/v1/kv/probe":
+                    self._kv_probe()
                     return
                 if self.path == "/profile":
                     req = self._read_json()
@@ -462,6 +502,7 @@ class BundleServer:
                 if request is None:
                     server_self.stats.record_error()
                     return
+                self._session_header(request)
                 ticket = self._begin_invoke(request)
                 if ticket is None:
                     return
@@ -503,6 +544,17 @@ class BundleServer:
                         self._send_shed(
                             Shed(503, "kv_pages", e.retry_after_s))
                         return
+                    except SessionPinsExceeded as e:
+                        # the session-pin budget is full: shed the NEW
+                        # session, priced by the earliest lease-expiry
+                        # horizon — pins never starve live traffic
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "session_pins", cls)
+                        self._send_shed(
+                            Shed(503, "session_pins", e.retry_after_s))
+                        return
                     except Exception as e:  # handler bug or bad payload shape
                         server_self.stats.record_error()
                         log_event(log, "invoke failed", error=str(e),
@@ -529,6 +581,7 @@ class BundleServer:
                     self._send(400, {"error": {"message": err,
                                                "type": "invalid_request_error"}})
                     return
+                self._session_header(internal)
                 # admit on the TRANSLATED request: the internal shape
                 # carries "tokens"/"max_new_tokens", so the estimator
                 # sees real prefill/decode counts (the raw OpenAI body
@@ -569,6 +622,17 @@ class BundleServer:
                             "kv_pages", cls)
                         self._send_shed(
                             Shed(503, "kv_pages", e.retry_after_s),
+                            openai=True)
+                        return
+                    except SessionPinsExceeded as e:
+                        # session-pin budget full: priced shed of the
+                        # NEW session, Retry-After = lease horizon
+                        cls = (self.headers.get("x-priority")
+                               or "interactive").strip().lower()
+                        server_self.sched.admission.count_shed(
+                            "session_pins", cls)
+                        self._send_shed(
+                            Shed(503, "session_pins", e.retry_after_s),
                             openai=True)
                         return
                     except Exception as e:
@@ -706,6 +770,29 @@ class BundleServer:
                 finally:
                     self._end_invoke(ticket, t0)
 
+            def _kv_probe(self):
+                """Host-only KV presence probe: how many head tokens the
+                radix tree actually holds. No admission gate — it is an
+                O(depth) dict walk with no device work, and the router
+                calls it on the import-miss pull path where queueing
+                behind a run slot would cost more than the re-ship it
+                guards."""
+                fn = getattr(server_self.boot.state, "kv_probe_fn", None)
+                request = self._read_json()
+                if request is None:
+                    return
+                if fn is None:
+                    self._send(404, {"ok": False, "error":
+                                     "no KV probe surface (prefix "
+                                     "cache off or unsupported handler)"})
+                    return
+                try:
+                    out = fn(request)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"ok": False, "error": str(e)})
+                    return
+                self._send(200 if out.get("ok") else 400, out)
+
             def _write_frame(self, body: bytes) -> bool:
                 """One chunked-transfer frame; False = client went away
                 (recorded on the connection, never raised — the failure
@@ -778,6 +865,20 @@ class BundleServer:
                                 logprobs=(payload.get("logprobs") or
                                           [None])[0]):
                             return
+                except SessionPinsExceeded as e:
+                    # the 200 is already committed (streams send headers
+                    # first), so the shed arrives as the terminal event —
+                    # shed-shaped and COUNTED as one, never an error
+                    cls = (self.headers.get("x-priority")
+                           or "interactive").strip().lower()
+                    server_self.sched.admission.count_shed(
+                        "session_pins", cls)
+                    event({"error": {
+                        "message": "shed: session_pins",
+                        "type": "overloaded_error",
+                        "retry_after_s": round(e.retry_after_s, 3)}})
+                    self._end_frames()
+                    return
                 except Exception as e:
                     server_self.stats.record_error()
                     log_event(log, "sse invoke failed", error=str(e),
@@ -824,6 +925,20 @@ class BundleServer:
                     for payload in stream_fn(request):
                         if not write_chunk(payload):
                             return
+                except SessionPinsExceeded as e:
+                    # headers are committed: the shed becomes the
+                    # terminal line, shed-shaped and counted as a shed
+                    # (not an error) like the non-streamed 503
+                    cls = (self.headers.get("x-priority")
+                           or "interactive").strip().lower()
+                    server_self.sched.admission.count_shed(
+                        "session_pins", cls)
+                    write_chunk({"ok": False, "shed": True,
+                                 "reason": "session_pins",
+                                 "retry_after_s":
+                                     round(e.retry_after_s, 3)})
+                    self._end_frames()
+                    return
                 except Exception as e:
                     server_self.stats.record_error()
                     log_event(log, "stream invoke failed", error=str(e),

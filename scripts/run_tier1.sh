@@ -53,6 +53,16 @@
 # at equal replica count, and an injected kv_ship failure completing
 # the whole burst bitwise with zero client-visible errors.
 #
+# Phase 13 is the MULTI-TURN SESSION sweep (bench.py --sessions,
+# subprocess replicas behind the sticky-session router): bitwise
+# transcript parity vs direct serving across {greedy, seeded-sampled}
+# x {dense, paged} x {healthy, mid-conversation replica SIGKILL},
+# zero client-visible errors through failover (incl. a reachable-home
+# failover whose KV re-ships old home -> new home), turn-2+ TTFT
+# <= 0.15x cold TTFT on a healthy home, and pinned-page accounting
+# returning to exactly zero after every session closes (DELETE fan-out
+# plus one lease expiry).
+#
 # Every phase prints its wall-clock so the budget breakdown is visible
 # in the log (ROADMAP open item: phase 2 runs close to its 870 s cap).
 
@@ -238,4 +248,18 @@ if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 12"
+
+# Phase 13: multi-turn sessions — bench.py --sessions exits nonzero if
+# any conversation turn diverges bitwise from the direct single-server
+# transcript (healthy, mid-conversation SIGKILL, or post-restart), if
+# any turn surfaces a client error during failover, if turn-2+ TTFT on
+# a healthy home exceeds 0.15x the cold turn-1 TTFT, or if pinned-leaf
+# accounting fails to return to zero after sessions close.
+phase_begin "phase 13: multi-turn session sweep (bench.py --sessions)"
+if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python bench.py --sessions; then
+    echo "FATAL: bench.py --sessions sweep failed" >&2
+    exit 1
+fi
+phase_end "phase 13"
 exit 0

@@ -134,9 +134,15 @@ class StubReplica:
                              "sp_standdown_reasons": {}}}
         self.cfg["kv_shed"] = False   # /v1/kv/import answers 503
         self.cfg["kv_frame"] = b"LKV1-stub-frame"  # /v1/kv/export body
+        # /v1/kv/probe: None = report the whole asked head as present
+        # (the dedup-preserving default); an int scripts a partial/empty
+        # match (a stale ship-dedup entry the router should PULL for)
+        self.cfg["kv_probe_matched"] = None
         self.invokes = 0
         self.exports = 0
+        self.probes = 0
         self.imports = []  # raw frames received on /v1/kv/import
+        self.deletes = []  # session ids received on DELETE /v1/sessions
         self.bodies = []  # (path, parsed body) of every POST received
         stub = self
 
@@ -194,6 +200,13 @@ class StubReplica:
                     return
                 body = json.loads(raw or b"{}")
                 stub.bodies.append((self.path, body))
+                if self.path == "/v1/kv/probe":
+                    stub.probes += 1
+                    matched = stub.cfg["kv_probe_matched"]
+                    if matched is None:
+                        matched = len(body.get("tokens") or [])
+                    self._send(200, {"ok": True, "matched": int(matched)})
+                    return
                 if self.path == "/v1/kv/export":
                     if stub.cfg["shed"] or stub.cfg["draining"]:
                         ra = stub.cfg["retry_after"]
@@ -244,8 +257,20 @@ class StubReplica:
                     return
                 self._send(200, {"ok": True, "replica": stub.name,
                                  "echo": body.get("tokens"),
+                                 "session":
+                                     self.headers.get("x-session-id")
+                                     or body.get("session_id"),
                                  "priority":
                                      self.headers.get("x-priority")})
+
+            def do_DELETE(self):
+                if self.path.startswith("/v1/sessions/"):
+                    sid = self.path[len("/v1/sessions/"):]
+                    stub.deletes.append(sid)
+                    self._send(200, {"ok": True, "session": sid,
+                                     "released": True})
+                    return
+                self._send(404, {"ok": False})
 
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), H)
         self.port = self.httpd.server_address[1]

@@ -429,3 +429,70 @@ def test_disagg_stats_threaded_counts():
     assert rep["ship_skips"] == 800
     assert rep["fallbacks"]["x"] == 800
     assert rep["ships"] == 800 and rep["ship_bytes_total"] == 8000
+
+
+def test_session_stats_report_shape():
+    from lambdipy_tpu.runtime.metrics import SessionStats
+
+    st = SessionStats()
+    st.count("opened")
+    st.count("sticky_hits", 3)
+    st.count("failovers")
+    st.count("reships")
+    st.count("deletes")
+    st.record_fallback("old_home_unreachable")
+    st.record_fallback("old_home_unreachable")
+    st.record_fallback("import_backpressure")
+    rep = st.report()
+    assert rep["opened"] == 1 and rep["sticky_hits"] == 3
+    assert rep["sticky_misses"] == 0
+    assert rep["failovers"] == 1 and rep["reships"] == 1
+    assert rep["deletes"] == 1
+    assert rep["reship_fallbacks"] == {"old_home_unreachable": 2,
+                                       "import_backpressure": 1}
+
+
+def test_prefix_store_stats_pin_surface():
+    """The session-pin gauges ride prefixstore.stats() even on an empty
+    tree — an operator watching pins squeeze cache headroom must see
+    zeros, not missing keys."""
+    from types import SimpleNamespace
+
+    from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+    server = SimpleNamespace(
+        model=SimpleNamespace(cfg=SimpleNamespace(max_len=128)))
+    store = PrefixStore(server, block=16, budget_mb=1,
+                        pin_budget_mb=0.5)
+    st = store.stats()
+    for key in ("sessions_active", "pinned_leaves", "pinned_bytes",
+                "pin_budget_bytes", "pin_sheds", "pin_overflows",
+                "pin_expiries", "pin_invalidations", "pin_faults"):
+        assert key in st, key
+    assert st["pin_budget_bytes"] == int(0.5 * 2**20)
+    assert st["pinned_leaves"] == 0 and st["sessions_active"] == 0
+    # a session on a sub-block prompt still opens (lease + DELETE work)
+    store.pin_session("s", [1, 2, 3])
+    st = store.stats()
+    assert st["sessions_active"] == 1 and st["pinned_leaves"] == 0
+    assert store.end_session("s")["released"]
+
+
+def test_page_pool_merges_pinned_gauges():
+    """batching.page_pool surfaces the store's pinned-page gauges via
+    the pinned_fn hook (merged OUTSIDE the pool lock), and a broken
+    provider never breaks the stats document."""
+    from lambdipy_tpu.runtime.pagepool import PagePool
+
+    pool = PagePool(n_pages=9, page=16, page_bytes=1024)
+    pool.pinned_fn = lambda: {"pinned_pages": 3, "pinned_bytes": 3072,
+                              "pin_budget_bytes": 8192, "pin_sheds": 1}
+    st = pool.stats()
+    assert st["pinned_pages"] == 3 and st["pinned_bytes"] == 3072
+    assert st["pin_budget_bytes"] == 8192 and st["pin_sheds"] == 1
+
+    def broken():
+        raise RuntimeError("boom")
+
+    pool.pinned_fn = broken
+    assert "pages_total" in pool.stats()  # still serves

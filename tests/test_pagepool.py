@@ -187,6 +187,64 @@ def test_fuzz_alloc_share_release_invariants():
     assert st["release_pages"] + st["pages_live"] == st["alloc_pages"]
 
 
+def test_fuzz_pin_shadow_model_sweep_never_frees_pinned():
+    """The session-pin extension of the fuzz: pages carry a PINNED flag
+    (the store's session pins, modeled as pure bookkeeping) and a
+    store-style sweep op releases only UNPINNED refcount-1 pages — the
+    exact contract the prefix store's reclaim/eviction sweeps honor.
+    Invariants hold through pin/unpin churn and the shadow model stays
+    exact: a pinned page is never freed by a sweep, only by its own
+    unpin + release."""
+    rng = np.random.default_rng(7)
+    pool = mkpool(n_pages=33, page=8, page_bytes=64)
+    shadow: dict[int, int] = {}      # pid -> model refcount
+    pinned: set[int] = set()         # the store's pinned leaves
+    for step in range(2000):
+        op = rng.integers(0, 5)
+        if op == 0:                  # alloc (a cold insert)
+            try:
+                pids = pool.alloc(int(rng.integers(1, 4)))
+            except PagesExhausted:
+                pass
+            else:
+                for p in pids:
+                    assert shadow.get(p, 0) == 0
+                    shadow[p] = 1
+        elif op == 1 and shadow:     # pin a live page (a session turn)
+            live = [p for p, r in shadow.items() if r > 0]
+            pinned.add(int(rng.choice(live)))
+        elif op == 2 and pinned:     # unpin (session end / lease lapse)
+            pinned.discard(int(rng.choice(sorted(pinned))))
+        elif op == 3 and shadow:     # a row shares/releases a page
+            live = [p for p, r in shadow.items() if r > 0]
+            p = int(rng.choice(live))
+            if rng.integers(0, 2) and shadow[p] > 1:
+                pool.release([p])
+                shadow[p] -= 1
+            else:
+                pool.retain([p])
+                shadow[p] += 1
+        else:                        # the store's cold-page sweep
+            victims = [p for p, r in shadow.items()
+                       if r == 1 and p not in pinned]
+            take = victims[:int(rng.integers(0, 4))]
+            pool.release(take)
+            for p in take:
+                del shadow[p]
+        pool.check_invariants()
+        for p in pinned:             # a pinned page is always live
+            assert pool.refcount(p) == shadow[p] > 0
+    # end every "session", then sweep: the pool drains to exactly the
+    # still-shared pages — pins never leaked a page
+    pinned.clear()
+    stuck = [p for p, r in shadow.items() if r == 1]
+    pool.release(stuck)
+    for p in stuck:
+        del shadow[p]
+    pool.check_invariants()
+    assert pool.stats()["pages_live"] == len(shadow)
+
+
 def test_concurrent_alloc_release_conserves_pages():
     pool = mkpool(n_pages=65, page=8, page_bytes=8)
     errs: list = []
