@@ -969,6 +969,242 @@ def _disagg_ship_failure(dec_url, pre_url, *, block, n_new, burst_len,
         pool.close()
 
 
+def _build_rtt_bundle(tmp, *, block: int, max_len: int,
+                      name: str = "disagg-rtt-bench"):
+    """The RTT sweep's bundle: prefill_chunk pinned to the prefix
+    block, so every cold-walk chunk is ONE block and the export stream
+    flushes one wire frame per block — the finest overlap granularity
+    the store produces, which is what a per-chunk synthetic RTT
+    measures."""
+    from lambdipy_tpu.buildengine import build_recipe
+    from lambdipy_tpu.bundle import assemble_bundle
+    from lambdipy_tpu.recipes.schema import load_recipe_dict
+
+    doc = {
+        "schema": 1, "name": name, "version": "0.1",
+        "device": "any", "base_layer": "jax-tpu", "requires": [],
+        "payload": {
+            "model": "llama-tiny",
+            "handler": "lambdipy_tpu.runtime.handlers:generate_handler",
+            "params": "init", "dtype": "float32",
+            "extra": {"max_new_tokens": "4", "serve_aot": "0",
+                      "warm_group_prefill": "0",
+                      "prefix_cache_mb": "64",
+                      "prefix_block": str(block),
+                      "prefill_chunk": str(block),
+                      "max_len": str(max_len), "hidden": "128",
+                      "sched_max_concurrency": "1",
+                      "batch_mode": "continuous",
+                      "batch_max": "4", "batch_segment": "8"},
+        },
+    }
+    result = build_recipe(load_recipe_dict(doc), tmp / "work",
+                          run_smoke=False)
+    bundle = tmp / "bundle"
+    assemble_bundle(result, bundle, with_payload=True)
+    return bundle
+
+
+def disagg_rtt_record(*, block: int = 32, max_len: int = 1024,
+                      chunk_ms: float = 66.0, walk_ms: float = 66.0,
+                      requests: int = 3, max_ratio: float = 0.6,
+                      ship_window: int = 4) -> dict:
+    """Synthetic-RTT axis for the disaggregated ship (CPU-runnable,
+    subprocess replicas): every relayed chunk pays ``chunk_ms`` through
+    the deterministic ``kv_ship_chunk`` delay site (the wire), and
+    every cold-walk chunk pays ``walk_ms`` through ``prefix_walk`` (the
+    prefill device time) — the PR-5/PR-12 modeled-time idiom. Two hard
+    gates:
+
+    1. OVERLAP — cold-request TTFT through the PIPELINED ship must be
+       <= ``max_ratio`` x the blocking (buffer-then-relay) ship's at
+       the same per-chunk RTT: with prefill and wire both paying
+       ~``chunk_ms`` per block, the blocking ship serializes them
+       (2 x N x chunk_ms) while the pipelined ship hides the transfer
+       under the remaining prefill (~N x chunk_ms) — the ROADMAP
+       "66 ms-RTT transport would motivate an async/pipelined ship"
+       remainder, measured.
+    2. DEGRADATION — with every relayed chunk failing (permanent
+       ``kv_ship_chunk`` exception), every request still answers
+       BITWISE the direct reference with zero client-visible errors,
+       and a repeated prefix re-ships (the aborted stream never marks
+       the dedup LRU).
+    """
+    import statistics
+    import tempfile
+    import urllib.request
+    from pathlib import Path
+
+    import numpy as np
+
+    from lambdipy_tpu.fleet import DECODE, PREFILL, FleetRouter, \
+        ReplicaPool
+    from lambdipy_tpu.runtime.faults import FaultPlan
+
+    tmp = Path(tempfile.mkdtemp(prefix="lambdipy-disagg-rtt-"))
+    bundle = _build_rtt_bundle(tmp, block=block, max_len=max_len)
+    rng = np.random.default_rng(1)
+    # head = the window-clamped whole-block prefix: max_len/block - 1
+    # blocks, one wire chunk each (prefill_chunk == block)
+    n_chunks = max_len // block - 1
+    prompt_len = n_chunks * block + block // 2
+
+    def post(base, path, payload, timeout=300):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def completion(base, row, *, max_tokens=1):
+        out = post(base, "/v1/completions",
+                   {"prompt": [int(t) for t in row],
+                    "max_tokens": max_tokens, "temperature": 0})
+        return out["choices"][0]["tokens"]
+
+    env_extra = {"LAMBDIPY_FAULT":
+                 f"prefix_walk:delay@ms={walk_ms:g},n=inf"}
+    (pd, dec_url, _), (pp, pre_url, _) = (
+        _spawn_replica_proc(bundle, env_extra=env_extra, tag="rtt-d"),
+        _spawn_replica_proc(bundle, env_extra=env_extra, tag="rtt-p"))
+    result: dict = {"mode": "disagg-rtt", "block": block,
+                    "max_len": max_len, "chunks_per_ship": n_chunks,
+                    "chunk_ms": chunk_ms, "walk_ms": walk_ms}
+    try:
+        def fresh_row():
+            return [int(t) for t in rng.integers(1, 500,
+                                                 size=prompt_len)]
+
+        def run_mode(pipelined: bool) -> dict:
+            pool = ReplicaPool(probe_interval=0.5, fail_threshold=2,
+                               probe_timeout=10.0)
+            pool.attach("dec", dec_url, role=DECODE)
+            pool.attach("pre", pre_url, role=PREFILL)
+            pool.probe_all()
+            pool.start()
+            router = FleetRouter(
+                pool, affinity_on=True, block=block, max_retries=2,
+                request_timeout=300, ship_window=ship_window,
+                ship_pipelined=pipelined,
+                faults=FaultPlan.from_spec(
+                    f"kv_ship_chunk:delay@ms={chunk_ms:g},n=inf")
+            ).start_background()
+            base = f"http://127.0.0.1:{router.port}"
+            try:
+                # off-the-clock warm: compiles the walk/continuation
+                # programs on both replicas so neither mode's timing
+                # pays a first-use compile
+                completion(base, fresh_row())
+                ttfts = []
+                for _ in range(requests):
+                    t0 = time.monotonic()
+                    completion(base, fresh_row())
+                    ttfts.append(time.monotonic() - t0)
+                rep = router.disagg.report()
+                if rep["decode_dispatches"] < requests + 1:
+                    raise AssertionError(
+                        f"rtt ({'pipelined' if pipelined else 'blocking'}"
+                        f"): ships did not land: {rep}")
+                if pipelined and rep["ships_pipelined"] < requests:
+                    raise AssertionError(
+                        f"rtt: pipelined mode did not stream: {rep}")
+                if rep["chunks_relayed"] < (requests + 1) * n_chunks:
+                    raise AssertionError(
+                        f"rtt: expected >= {(requests + 1) * n_chunks} "
+                        f"relayed chunks, saw {rep['chunks_relayed']}")
+                if rep["fallbacks"]:
+                    raise AssertionError(
+                        f"rtt: ships fell back under plain RTT: "
+                        f"{rep['fallbacks']}")
+                return {"ttft_median_s": round(
+                            statistics.median(ttfts), 3),
+                        "ttft_s": [round(t, 3) for t in ttfts],
+                        "ships": rep["ships"],
+                        "chunks_relayed": rep["chunks_relayed"],
+                        "ship_ms_ewma": rep["ship_ms_ewma"]}
+            finally:
+                router.stop()
+                pool.close()
+
+        result["blocking"] = run_mode(False)
+        result["pipelined"] = run_mode(True)
+        ratio = (result["pipelined"]["ttft_median_s"]
+                 / max(1e-9, result["blocking"]["ttft_median_s"]))
+        result["ttft_ratio"] = round(ratio, 3)
+        result["max_ratio"] = max_ratio
+        if ratio > max_ratio:
+            raise AssertionError(
+                f"disagg-rtt: pipelined TTFT is {ratio:.2f}x the "
+                f"blocking ship's (gate <= {max_ratio}x): {result}")
+
+        # ---- permanent mid-stream failure: bitwise, zero errors -----
+        rows = [fresh_row() for _ in range(requests)]
+        refs = [completion(pre_url, row, max_tokens=4) for row in rows]
+        pool = ReplicaPool(probe_interval=0.5, fail_threshold=2,
+                           probe_timeout=10.0)
+        pool.attach("dec", dec_url, role=DECODE)
+        pool.attach("pre", pre_url, role=PREFILL)
+        pool.probe_all()
+        pool.start()
+        router = FleetRouter(
+            pool, affinity_on=True, block=block, max_retries=2,
+            request_timeout=300, ship_window=ship_window,
+            faults=FaultPlan.from_spec(
+                "kv_ship_chunk:exception@seg=1,n=inf")
+        ).start_background()
+        base = f"http://127.0.0.1:{router.port}"
+        try:
+            errors = []
+            for i, row in enumerate(rows):
+                try:
+                    got = completion(base, row, max_tokens=4)
+                    if got != refs[i]:
+                        errors.append(f"row {i}: tokens diverged")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"row {i}: {type(e).__name__}: {e}")
+            # dedup must not be poisoned by aborted streams: the same
+            # prefix re-ships (and re-fails, and still serves) instead
+            # of silently skipping
+            repeat = completion(base, rows[0], max_tokens=4)
+            if repeat != refs[0]:
+                errors.append("repeat: tokens diverged")
+            rep = router.disagg.report()
+            if errors:
+                raise AssertionError(
+                    f"disagg-rtt failure leg: client-visible damage "
+                    f"with chunks down: {errors[:3]}")
+            if rep["ships"] != 0:
+                raise AssertionError(
+                    "disagg-rtt failure leg: a ship landed despite "
+                    "the permanent chunk fault")
+            if rep["fallbacks"].get("ship_chunk_fault", 0) \
+                    < requests + 1:
+                raise AssertionError(
+                    f"disagg-rtt failure leg: expected every attempt "
+                    f"(incl. the repeat) to re-ship and fault, saw "
+                    f"{rep['fallbacks']}")
+            if rep["ship_skips"] != 0:
+                raise AssertionError(
+                    "disagg-rtt failure leg: an aborted stream marked "
+                    "the ship-dedup LRU")
+            result["ship_chunk_failure"] = {
+                "requests": len(rows) + 1, "delivered": len(rows) + 1,
+                "fallbacks": rep["fallbacks"],
+                "mid_stream_failures": rep["mid_stream_failures"],
+                "parity": True}
+        finally:
+            router.stop()
+            pool.close()
+    finally:
+        for p in (pd, pp):
+            p.kill()
+    result["passed"] = True
+    import jax
+
+    result["platform"] = jax.devices()[0].platform
+    return result
+
+
 def _build_sessions_bundle(tmp, *, n_new: int, block: int,
                            name: str = "sessions-bench"):
     """The tiny llama bundle the sessions sweep serves: continuous
@@ -2871,6 +3107,28 @@ def _disagg_main() -> int:
     return 0
 
 
+def _disagg_rtt_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--disagg-rtt", action="store_true")
+    ap.add_argument("--block", type=int, default=32)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--chunk-ms", type=float, default=66.0)
+    ap.add_argument("--walk-ms", type=float, default=66.0)
+    ap.add_argument("--requests", type=int, default=3)
+    ap.add_argument("--max-ratio", type=float, default=0.6)
+    ap.add_argument("--ship-window", type=int, default=4)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(disagg_rtt_record(
+        block=args.block, max_len=args.max_len,
+        chunk_ms=args.chunk_ms, walk_ms=args.walk_ms,
+        requests=args.requests, max_ratio=args.max_ratio,
+        ship_window=args.ship_window)))
+    return 0
+
+
 def _sessions_main() -> int:
     import argparse
 
@@ -3186,6 +3444,13 @@ def main() -> int:
         # zero-copy prefix-hit claim (assembly bytes eliminated), and
         # the token-bounded capacity margin under a fixed HBM budget
         return _paged_main()
+    if "--disagg-rtt" in sys.argv:
+        # synthetic-RTT axis for the pipelined ship: per-chunk wire
+        # delay via the kv_ship_chunk fault site — cold TTFT through
+        # the chunked relay <= 0.6x the blocking ship's at 66 ms per
+        # chunk (transfer hidden under prefill), plus bitwise delivery
+        # with zero client errors under permanent mid-stream failure
+        return _disagg_rtt_main()
     if "--disagg" in sys.argv:
         # CPU-runnable disaggregated prefill/decode sweep (subprocess
         # replicas): bitwise split-fleet-vs-direct parity (greedy +

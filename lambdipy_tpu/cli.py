@@ -661,12 +661,18 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
 @click.option("--session-ttl", type=float, default=None,
               help="per-replica absolute session pin lease in seconds "
                    "(see `lambdipy serve --session-ttl`)")
+@click.option("--ship-window", type=int, default=4, show_default=True,
+              help="pipelined KV shipping: max chunk frames in flight "
+                   "between the export and import legs of a phase-split "
+                   "ship (each flushed as its prefill chunk completes, "
+                   "so cross-host transfer hides under the remaining "
+                   "prefill); 0 = the blocking single-frame ship")
 def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
               affinity, block, probe_interval, fail_threshold,
               readmit_passes, retries, saturation, hedge, timeout,
               engine_watchdog, attach_urls, spill_cap, spill_max_wait,
               breaker_fails, breaker_open_s, retry_budget, fault_spec,
-              session_pin_budget, session_ttl):
+              session_pin_budget, session_ttl, ship_window):
     """Serve a bundle from N supervised replicas behind one router.
 
     Spawns REPLICAS watchdogged deployments of BUNDLE, health-probes
@@ -767,6 +773,7 @@ def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
                              breaker_fails=breaker_fails,
                              breaker_open_s=breaker_open_s,
                              retry_budget=retry_budget,
+                             ship_window=ship_window,
                              faults=fleet_faults)
     except BaseException:
         # a half-spawned fleet must not leak processes — including on

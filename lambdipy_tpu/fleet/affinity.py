@@ -139,6 +139,20 @@ def warm_prompt(request: dict, *, block: int = DEFAULT_BLOCK,
     return None
 
 
+# the phase-split ship moves KV, not affinity: the 8-block affinity
+# window is sized for rendezvous key stability, but a ship clipped to
+# it would leave every token past block 8 as a local re-prefill on the
+# decode replica — exactly the work the prefill class exists to absorb,
+# and (pipelined) exactly the transfer the chunk stream hides under the
+# prefill. 64 blocks (2-4k tokens at the default widths) covers the
+# window-clamped head of everything this stack serves; the export leg
+# clamps to the replica's window server-side either way. The ship-dedup
+# key stays the 8-block affinity key — two prompts sharing the window
+# but diverging later hit the dedup entry, and the import-miss PROBE
+# (which checks the full head) pulls the divergent tail back.
+SHIP_KEY_BLOCKS = 64
+
+
 def ship_prompt(request: dict, *, block: int = DEFAULT_BLOCK,
                 key_blocks: int = DEFAULT_KEY_BLOCKS) -> list | None:
     """:func:`warm_prompt` restricted to TOKEN heads — what the

@@ -108,6 +108,13 @@ class Replica:
     # replica — whose radix cache died with its worker — warms again
     warmed: bool = False
     outstanding: int = 0
+    # time-weighted occupancy accounting (fed by acquire/release): total
+    # seconds this replica had at least one request outstanding, plus
+    # the start of the currently open busy interval. The router's
+    # per-class utilization EWMAs (fleet.disagg.util) integrate these —
+    # the observability basis for prefill-pool sizing.
+    busy_s: float = 0.0
+    busy_since: float | None = field(default=None, repr=False)
     consecutive_fails: int = 0
     consecutive_passes: int = 0
     pid: int | None = None         # serving WORKER pid (healthz), not the
@@ -161,6 +168,14 @@ class ReplicaPool:
         # after an ejection — the router hooks affinity-aware cache
         # warming here; exceptions are swallowed (warming is advisory)
         self.on_admit = None
+        # fired SYNCHRONOUSLY (outside the pool lock) the moment
+        # begin_drain marks a replica DRAINING — before any /shutdown
+        # reaches its server, so the replica still serves. The router
+        # hooks proactive session KV re-ship here: pinned conversation
+        # heads move to their rendezvous successor while the old home
+        # can still export them. Exceptions are swallowed (a re-ship is
+        # an optimization; the turn-time failover path remains).
+        self.on_drain = None
         self.replicas: dict[str, Replica] = {}
         self.runtime: LocalRuntime | None = None
         self._lock = threading.Lock()
@@ -355,11 +370,37 @@ class ReplicaPool:
 
     def acquire(self, r: Replica) -> None:
         with self._lock:
+            if r.outstanding == 0:
+                r.busy_since = time.monotonic()
             r.outstanding += 1
 
     def release(self, r: Replica) -> None:
         with self._lock:
             r.outstanding = max(0, r.outstanding - 1)
+            if r.outstanding == 0 and r.busy_since is not None:
+                r.busy_s += time.monotonic() - r.busy_since
+                r.busy_since = None
+
+    def busy_totals(self) -> dict:
+        """Per-class occupancy snapshot: cumulative busy seconds (open
+        intervals closed at now), replica count, and live outstanding —
+        the raw material for the router's busy-fraction EWMAs."""
+        now = time.monotonic()
+        out: dict = {}
+        with self._lock:
+            for r in self.replicas.values():
+                if r.state == STOPPED:
+                    continue
+                busy = r.busy_s
+                if r.busy_since is not None:
+                    busy += now - r.busy_since
+                cls = out.setdefault(r.role, {"busy_s": 0.0,
+                                              "replicas": 0,
+                                              "outstanding": 0})
+                cls["busy_s"] += busy
+                cls["replicas"] += 1
+                cls["outstanding"] += r.outstanding
+        return out
 
     def bump(self, r: Replica, counter: str, n: int = 1) -> None:
         """Locked increment of a per-replica router counter
@@ -382,6 +423,15 @@ class ReplicaPool:
                     f"lifecycle — it is ejected/readmitted on health, never "
                     f"drained or restarted by this pool")
             r.state = DRAINING
+            hook = self.on_drain
+        if hook is not None:
+            # synchronous on purpose: rolling_restart POSTs /shutdown
+            # right after this returns, and the proactive re-ship must
+            # export from the draining replica while it still serves
+            try:
+                hook(r)
+            except Exception:  # noqa: BLE001 — re-ship is advisory
+                log_event(log, "on_drain hook failed", name=name)
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -110,7 +110,7 @@ import urllib.error
 import urllib.request
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from queue import Empty, Queue
+from queue import Empty, Full, Queue
 
 from lambdipy_tpu.fleet import affinity
 from lambdipy_tpu.fleet.breaker import CircuitBreaker, RetryBudget
@@ -118,6 +118,8 @@ from lambdipy_tpu.fleet.pool import PREFILL, Replica, ReplicaPool
 from lambdipy_tpu.fleet.spill import SPILL_DEADLINE, SpillQueue
 from lambdipy_tpu.runtime.deploy import _http_json
 from lambdipy_tpu.runtime.faults import FaultPlan, InjectedFault
+from lambdipy_tpu.runtime.kvwire import MAGIC as _KV_MAGIC
+from lambdipy_tpu.runtime.kvwire import FrameSplitter
 from lambdipy_tpu.runtime.metrics import (DisaggStats, RouterStats,
                                           SessionStats)
 from lambdipy_tpu.sched.admission import Shed
@@ -128,6 +130,15 @@ log = get_logger("lambdipy.fleet.router")
 _FORWARD_HEADERS = ("x-priority", "x-deadline-ms", "x-api-key", "x-tenant",
                     "x-session-id", "x-session-ttl-s")
 _ROUTED_PATHS = ("/invoke", "/v1/completions")
+
+
+class _ShipStalled(Exception):
+    """The ship relay's own stall signal (reader window parked past the
+    deadline, or the export feed going quiet). Deliberately NOT a
+    TimeoutError: on py3.10 ``socket.timeout`` IS ``TimeoutError``, and
+    an import-leg send timeout must be classified against the decode
+    replica, never surface through the reader-side passthrough and
+    penalize the healthy prefill replica's breaker."""
 
 
 class FleetRouter:
@@ -143,6 +154,7 @@ class FleetRouter:
                  breaker_outlier_ms: float = 0.0,
                  retry_budget: float = 0.0, retry_budget_min: int = 3,
                  warm_prefixes: int = 4,
+                 ship_window: int = 4, ship_pipelined: bool = True,
                  faults: FaultPlan | None = None):
         self.pool = pool
         self.affinity_on = bool(affinity_on)
@@ -190,6 +202,18 @@ class FleetRouter:
         self._shipped: dict[str, OrderedDict] = {}
         self._shipped_cap = 512
         self._ship_lock = threading.Lock()
+        # pipelined (chunked) shipping: ship_window bounds the relay's
+        # in-flight chunk frames between the export and import legs
+        # (0 = the pre-chunking monolithic ship, one LKV1 frame per
+        # round trip); ship_pipelined=False keeps the chunked wire but
+        # buffers the whole export before relaying — the blocking
+        # baseline bench.py --disagg-rtt measures the overlap against
+        self.ship_window = max(0, int(ship_window))
+        self.ship_pipelined = bool(ship_pipelined)
+        # per-class busy-fraction EWMAs (fleet.disagg.util), folded
+        # from the pool's time-weighted occupancy at scrape time
+        self._util_lock = threading.Lock()
+        self._util_prev = {"t": time.monotonic(), "busy": {}}
         # sticky multi-turn sessions: sid -> {home, head, key}, LRU-
         # bounded (losing a record only loses stickiness — the next turn
         # re-places by prefix affinity, which is where the KV lives
@@ -202,6 +226,11 @@ class FleetRouter:
         # on_admit is always hooked: it clears the shipped-key cache
         # for a readmitted replica, then (when enabled) cache-warms it
         pool.on_admit = self._on_replica_admitted
+        # on_drain: proactive session re-ship — a draining home's
+        # pinned conversation heads move to their rendezvous successor
+        # BEFORE the drain's /shutdown, so the next turn pays a sticky
+        # hit instead of a failover re-prefill (ROADMAP 5a remainder)
+        pool.on_drain = self._on_replica_drain
         self._rr = 0  # tie-break rotation for least-outstanding picks
         self._rr_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
@@ -611,10 +640,10 @@ class FleetRouter:
     def _session_reship(self, head, old_name: str | None,
                         new_rep: Replica) -> str | None:
         """Export the session head's KV from the old home and import it
-        on the new one. Returns None on success, else the fallback
-        reason. Both legs ride :meth:`_forward` (breakers see them);
-        neither retries — a failed re-ship costs one local re-prefill,
-        never a lost turn."""
+        on the new one, through the same pipelined relay the
+        phase-split ship rides. Returns None on success, else the
+        fallback reason; nothing retries — a failed re-ship costs one
+        local re-prefill, never a lost turn."""
         try:
             self.faults.check("session_failover")
         except InjectedFault:
@@ -624,28 +653,66 @@ class FleetRouter:
         old = self.pool.replicas.get(old_name) if old_name else None
         if old is None:
             return "no_old_home"
-        try:
-            status, _, frame = self._forward(
-                old, "/v1/kv/export",
-                json.dumps({"tokens": head}).encode(),
-                {"Content-Type": "application/json"})
-        except Exception:  # noqa: BLE001 — the SIGKILL case
-            return "old_home_unreachable"
-        if status != 200:
-            return "export_failed"
-        try:
-            istatus, _, _ = self._forward(
-                new_rep, "/v1/kv/import", frame,
-                {"Content-Type": "application/octet-stream"})
-        except Exception as e:  # noqa: BLE001
-            if not self._is_timeout(e):
-                self.pool.note_failure(new_rep)
-            return "import_failed"
-        if istatus in (429, 503):
-            return "import_backpressure"
-        if istatus != 200:
-            return "import_failed"
-        return None
+        reason, _info = self._ship_relay(
+            old, new_rep, head, {"Content-Type": "application/json"})
+        if reason is None:
+            return None
+        # the relay's vocabulary, translated to the session failover's:
+        # an unreachable old home is the SIGKILL case (its KV died with
+        # the worker — the new home's re-prefill IS the recovery)
+        return {"export_unreachable": "old_home_unreachable",
+                "import_unreachable": "import_failed"}.get(reason,
+                                                           reason)
+
+    def _on_replica_drain(self, replica: Replica) -> None:
+        """Pool ``on_drain`` hook: ``begin_drain`` just marked
+        ``replica`` DRAINING (its server still serves — the /shutdown
+        comes after this returns), so every session homed there can
+        move its pinned KV head to its rendezvous successor NOW,
+        through the pipelined relay, instead of paying a failover
+        re-prefill on the next turn. Per-session failures degrade to
+        exactly that turn-time failover path (counted by reason); only
+        a SUCCESSFUL re-ship re-homes the record."""
+        with self._session_lock:
+            affected = [(sid, rec)
+                        for sid, rec in self._session_map.items()
+                        if rec.get("home") == replica.name]
+        if not affected:
+            return
+        cands = {r.name: r for r in self.pool.routable()
+                 if r.role != PREFILL and r.name != replica.name
+                 and not self._breaker_blocked(r)}
+        if not cands:
+            return  # nowhere to re-home; turn-time failover owns it
+        for sid, rec in affected:
+            new_home = affinity.pick_replica(
+                affinity.session_key(sid), sorted(cands))
+            akey = rec.get("key")
+            if akey is not None:
+                with self._ship_lock:
+                    for seen in self._shipped.values():
+                        seen.pop(akey, None)
+            reason = self._session_reship(rec.get("head"), replica.name,
+                                          cands[new_home])
+            if reason is not None:
+                self.sessions.record_fallback(reason)
+                log_event(log, "drain re-ship failed, next turn fails "
+                          "over", session=sid[:16], old=replica.name,
+                          reason=reason)
+                continue
+            with self._session_lock:
+                if self._session_map.get(sid) is rec:
+                    rec["home"] = new_home
+            if akey is not None:
+                with self._ship_lock:
+                    seen = self._shipped.setdefault(new_home,
+                                                    OrderedDict())
+                    seen[akey] = True
+                    while len(seen) > self._shipped_cap:
+                        seen.popitem(last=False)
+            self.sessions.count("drain_reships")
+            log_event(log, "session re-shipped at drain",
+                      session=sid[:16], old=replica.name, new=new_home)
 
     def _note_session_home(self, sid: str | None, replica_name: str,
                            body: dict, key: bytes | None) -> None:
@@ -730,6 +797,321 @@ class FleetRouter:
 
     # -- disaggregated prefill/decode (phase-split) ship ---------------------
 
+    def _ship_relay(self, src: Replica, dst: Replica, head: list,
+                    headers: dict) -> tuple[str | None, dict]:
+        """Pump ``src``'s ``/v1/kv/export`` into ``dst``'s
+        ``/v1/kv/import``. With ``ship_window > 0`` the export is
+        CHUNKED: a reader thread pulls wire frames off the export
+        response as the prefill produces them and a bounded queue
+        (``ship_window`` frames) feeds the import leg's chunked POST —
+        so wire transfer and the decode side's staging both overlap the
+        prefill chunks still running on ``src``. ``ship_pipelined=False``
+        keeps the chunked wire but buffers the full export first (the
+        blocking baseline); an ``LKV1`` response (a pre-chunking
+        replica, or ``ship_window=0``) relays as one monolithic frame.
+
+        Returns ``(fallback_reason | None, info)``. Reasons distinguish
+        unreachable legs (``export_unreachable``/``import_unreachable``
+        — the caller maps them per its own vocabulary and the dead
+        replica was already reported to the pool) from sheds, garbage,
+        and injected faults (``ship_fault`` pre-stream,
+        ``ship_chunk_fault`` mid-stream). Both legs feed the circuit
+        breakers; nothing here retries — a failed ship costs one local
+        prefill, never a lost request."""
+        info: dict = {"nbytes": 0, "chunks": 0, "pipelined": False,
+                      "export_ok": False, "import": {}}
+        use_stream = self.ship_window > 0
+        payload: dict = {"tokens": head}
+        if use_stream:
+            payload["stream"] = True
+        req = urllib.request.Request(
+            src.url + "/v1/kv/export", data=json.dumps(payload).encode(),
+            headers=headers, method="POST")
+        t0 = time.monotonic()
+        deadline = t0 + self.request_timeout
+        self.pool.acquire(src)
+        resp = None
+        try:
+            try:
+                self.faults.check("route_latency")
+                self.faults.check("route_connect")
+                resp = urllib.request.urlopen(
+                    req, timeout=self.request_timeout)
+            except urllib.error.HTTPError as e:
+                e.read()
+                self._breaker_result(src, ok=e.code < 500
+                                     or e.code == 503)
+                return ("export_shed" if e.code in (429, 503)
+                        else "export_failed"), info
+            except InjectedFault:
+                self._breaker_result(src, ok=False)
+                return "ship_fault", info
+            except Exception as e:  # noqa: BLE001 — connection-level
+                if not self._is_timeout(e):
+                    self._breaker_result(src, ok=False)
+                    self.pool.note_failure(src)
+                return "export_unreachable", info
+            # sniff the first frame's magic: LKV1 = monolithic (an
+            # unchunked replica, or stream off), LKVS = chunked stream
+            try:
+                first = resp.read(4)
+            except Exception:  # noqa: BLE001
+                self._breaker_result(src, ok=False)
+                self.pool.note_failure(src)
+                return "export_unreachable", info
+            if first == _KV_MAGIC:
+                return self._relay_monolithic(src, dst, resp, first,
+                                              headers, info)
+            if first != b"LKVS":
+                self._breaker_result(src, ok=False)
+                return "export_failed", info
+            return self._relay_stream(src, dst, resp, first, headers,
+                                      info, deadline)
+        finally:
+            self.pool.release(src)
+            if resp is not None:
+                try:
+                    resp.close()
+                except OSError:
+                    pass
+
+    def _relay_monolithic(self, src: Replica, dst: Replica, resp,
+                          first: bytes, headers: dict,
+                          info: dict) -> tuple[str | None, dict]:
+        """The compat/legacy leg: one LKV1 frame, one import POST."""
+        try:
+            frame = first + resp.read()
+            self.faults.check("route_body")
+        except InjectedFault:
+            self._breaker_result(src, ok=False)
+            return "ship_fault", info
+        except Exception as e:  # noqa: BLE001
+            if not self._is_timeout(e):
+                self._breaker_result(src, ok=False)
+                self.pool.note_failure(src)
+            return "export_unreachable", info
+        self._breaker_result(src, ok=True)
+        info["export_ok"] = True
+        info["nbytes"] = len(frame)
+        imp_headers = {**headers,
+                       "Content-Type": "application/octet-stream"}
+        try:
+            istatus, _, ibody = self._forward(dst, "/v1/kv/import",
+                                              frame, imp_headers)
+        except InjectedFault:
+            return "ship_fault", info
+        except Exception as e:  # noqa: BLE001
+            if not self._is_timeout(e):
+                self.pool.note_failure(dst)
+            return "import_unreachable", info
+        return self._import_outcome(istatus, ibody, info)
+
+    def _relay_stream(self, src: Replica, dst: Replica, resp,
+                      first: bytes, headers: dict, info: dict,
+                      deadline: float) -> tuple[str | None, dict]:
+        """The chunked pump. Mid-stream failures close the import leg
+        WITHOUT the terminal chunk, so the decode replica's staged
+        pages roll back and its tree (and the ship-dedup LRU above it)
+        is never told about a half-arrived head."""
+        split = FrameSplitter()
+        # the window only applies when a reader thread feeds a writer
+        # concurrently; the buffered baseline reads inline with nobody
+        # consuming yet, so its queue must be unbounded or it deadlocks
+        frames_q: Queue = Queue(
+            maxsize=max(1, self.ship_window) if self.ship_pipelined
+            else 0)
+        rd_err: list = []
+        # set when the writer gives up: a reader parked on a full
+        # window must unblock NOW, not after the request timeout — a
+        # dead import leg would otherwise pin one thread plus a
+        # window's worth of KV frames per failed ship for minutes
+        abort = threading.Event()
+        info["pipelined"] = self.ship_pipelined
+
+        def q_put(item) -> None:
+            while True:
+                if abort.is_set():
+                    raise _ShipStalled("ship relay aborted")
+                if time.monotonic() > deadline:
+                    raise _ShipStalled("ship relay window stalled")
+                try:
+                    frames_q.put(item, timeout=0.1)
+                    return
+                except Full:
+                    continue
+
+        def read_frames() -> None:
+            try:
+                data = first
+                while True:
+                    for item in split.feed(data):
+                        q_put(item)
+                    if split.complete:
+                        break
+                    data = resp.read(65536)
+                    if not data:
+                        raise ValueError("export stream truncated")
+                self.faults.check("route_body")
+            except Exception as e:  # noqa: BLE001 — writer classifies
+                rd_err.append(e)
+            finally:
+                try:
+                    frames_q.put(None, timeout=1.0)
+                except Full:  # writer already gone; nothing drains
+                    pass
+
+        if self.ship_pipelined:
+            threading.Thread(target=read_frames, daemon=True,
+                             name="kv-ship-relay").start()
+
+            def frame_iter():
+                while True:
+                    try:
+                        item = frames_q.get(timeout=max(
+                            0.1, deadline - time.monotonic()))
+                    except Empty:
+                        raise _ShipStalled(
+                            "export stream stalled") from None
+                    if item is None:
+                        return
+                    yield item
+        else:
+            # the blocking baseline: the whole export (prefill
+            # included) lands before the first import byte moves
+            read_frames()
+
+            def frame_iter():
+                while True:
+                    item = frames_q.get_nowait()
+                    if item is None:
+                        return
+                    yield item
+
+        conn = None
+        mid_stream = False
+        # acquired BEFORE the connection opens (the _forward rule): the
+        # lazy connect inside endheaders() can fail, and a release
+        # without its acquire would skew outstanding/busy accounting
+        self.pool.acquire(dst)
+        try:
+            try:
+                self.faults.check("route_latency")
+                self.faults.check("route_connect")
+                host, _, port = dst.url.rpartition("//")[2].partition(":")
+                conn = http.client.HTTPConnection(
+                    host, int(port or 80), timeout=self.request_timeout)
+                conn.putrequest("POST", "/v1/kv/import",
+                                skip_accept_encoding=True)
+                conn.putheader("Content-Type",
+                               "application/x-lkv-stream")
+                conn.putheader("Transfer-Encoding", "chunked")
+                for name, value in headers.items():
+                    if name.lower() != "content-type":
+                        conn.putheader(name, value)
+                conn.endheaders()
+            except InjectedFault:
+                return "ship_fault", info
+            except Exception as e:  # noqa: BLE001
+                if not self._is_timeout(e):
+                    self.pool.note_failure(dst)
+                return "import_unreachable", info
+            try:
+                try:
+                    for kind, frame in frame_iter():
+                        mid_stream = True
+                        if kind == "chunk":
+                            self.faults.check("kv_ship_chunk")
+                        conn.send(f"{len(frame):x}\r\n".encode()
+                                  + frame + b"\r\n")
+                        info["nbytes"] += len(frame)
+                        if kind == "chunk":
+                            info["chunks"] += 1
+                except InjectedFault as e:
+                    # the chunk site fired router-side: neither replica
+                    # is at fault — close the import leg unterminated
+                    # (dst rolls back its staged pages) and degrade
+                    site = getattr(e, "fault_site", "")
+                    self.disagg.count("mid_stream_failures")
+                    return ("ship_chunk_fault"
+                            if site == "kv_ship_chunk"
+                            else "ship_fault"), info
+                except (_ShipStalled, ValueError):
+                    raise  # reader-side problems classified below
+                except Exception as e:  # noqa: BLE001 — import leg
+                    # died (incl. a send timeout: socket.timeout IS
+                    # TimeoutError on py3.10 — it belongs HERE, against
+                    # the decode replica, not the export classifier)
+                    if mid_stream:
+                        self.disagg.count("mid_stream_failures")
+                    if not self._is_timeout(e):
+                        self.pool.note_failure(dst)
+                    return "import_unreachable", info
+                if rd_err:
+                    raise rd_err[0]
+                self._breaker_result(src, ok=True)
+                info["export_ok"] = True
+                try:
+                    conn.send(b"0\r\n\r\n")
+                    iresp = conn.getresponse()
+                    istatus, ibody = iresp.status, iresp.read()
+                except Exception as e:  # noqa: BLE001
+                    self.disagg.count("mid_stream_failures")
+                    if not self._is_timeout(e):
+                        self._breaker_result(dst, ok=False)
+                        self.pool.note_failure(dst)
+                    return "import_unreachable", info
+                return self._import_outcome(istatus, ibody, info,
+                                            dst=dst)
+            except (_ShipStalled, ValueError, InjectedFault,
+                    OSError, http.client.HTTPException) as e:
+                # export-side stream failure (truncated, garbage,
+                # stalled, or a route fault while reading): the import
+                # leg is abandoned unterminated — staged pages roll back
+                export_failed = e
+                if rd_err and isinstance(rd_err[0], Exception):
+                    export_failed = rd_err[0]
+                self.disagg.count("mid_stream_failures")
+                self._breaker_result(src, ok=False)
+                if isinstance(export_failed, InjectedFault):
+                    return "ship_fault", info
+                if isinstance(export_failed, (OSError,
+                                              http.client.HTTPException)) \
+                        and not self._is_timeout(export_failed):
+                    self.pool.note_failure(src)
+                    return "export_unreachable", info
+                return "export_failed", info
+        finally:
+            abort.set()  # unblock a reader parked on the window
+            self.pool.release(dst)
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _import_outcome(self, istatus: int, ibody: bytes, info: dict,
+                        dst: Replica | None = None
+                        ) -> tuple[str | None, dict]:
+        """Shared import-status handling. ``dst`` feeds the breaker on
+        the streamed leg (the monolithic leg rode ``_forward``, which
+        already did)."""
+        if dst is not None:
+            self._breaker_result(dst, ok=istatus < 500
+                                 or istatus == 503)
+        if istatus in (429, 503):
+            # decode-side backpressure (full page arena / shedding
+            # admission): honor it by NOT forcing more KV into the
+            # replica — local prefill there is charged through its own
+            # admission instead
+            return "import_backpressure", info
+        if istatus != 200:
+            return "import_failed", info
+        try:
+            info["import"] = json.loads(ibody)
+        except (ValueError, TypeError):
+            info["import"] = {}
+        return None, info
+
     def _maybe_ship(self, key: bytes | None, body: dict,
                     headers: dict, sticky: str | None = None) -> None:
         """Phase-split a cold request: run its prefill on a PREFILL-
@@ -749,7 +1131,8 @@ class FleetRouter:
             # the wrong replica half the time
             self.disagg.record_fallback("no_affinity_key")
             return
-        head = affinity.ship_prompt(body, block=self.block)
+        head = affinity.ship_prompt(body, block=self.block,
+                                    key_blocks=affinity.SHIP_KEY_BLOCKS)
         if head is None:
             # string prompts (the router never tokenizes) or sub-block
             # heads: nothing the KV wire can frame
@@ -805,62 +1188,38 @@ class FleetRouter:
             return
         pre = min(prefills, key=lambda r: r.outstanding)
         t0 = time.monotonic()
-        # export leg: the prefill replica prefills missing blocks and
-        # frames the head's KV. Ships never retry (a failed ship costs
-        # a local prefill, not a lost request — no budget to spend),
-        # but both legs ride _forward, so breakers see them.
+        # the relay pumps export -> import (chunked when ship_window >
+        # 0: wire transfer and decode-side staging overlap the prefill
+        # chunks still running on the prefill replica). Ships never
+        # retry (a failed ship costs a local prefill, not a lost
+        # request — no budget to spend), but both legs feed breakers.
         try:
             self.faults.check("kv_ship")
-            status, hdrs, frame = self._forward(
-                pre, "/v1/kv/export",
-                json.dumps({"tokens": head}).encode(), headers)
-        except Exception as e:  # noqa: BLE001 — fall back to mixed
-            if isinstance(e, InjectedFault):
-                # the kv_ship site fires BEFORE any connection opens: a
-                # simulated ship failure says nothing about the replica
-                fall("ship_fault")
-            else:
-                if not self._is_timeout(e):
-                    self.pool.note_failure(pre)
-                fall("export_failed")
-            log_event(log, "kv export failed, serving mixed",
+        except InjectedFault as e:
+            # the kv_ship site fires BEFORE any connection opens: a
+            # simulated ship failure says nothing about the replica
+            fall("ship_fault")
+            log_event(log, "kv ship fault, serving mixed",
                       replica=pre.name, error=str(e))
             return
-        if status != 200:
-            fall("export_shed" if status in (429, 503) else
-                 "export_failed")
+        reason, info = self._ship_relay(pre, dec, head, headers)
+        if info.get("export_ok"):
+            self.disagg.count("prefill_dispatches")
+        if reason is not None:
+            fall({"export_unreachable": "export_failed",
+                  "import_unreachable": "import_failed"}.get(reason,
+                                                             reason))
+            log_event(log, "kv ship failed, serving mixed",
+                      prefill=pre.name, decode=dec.name, reason=reason,
+                      chunks=info.get("chunks", 0))
             return
-        self.disagg.count("prefill_dispatches")
-        # import leg: the decode replica registers the shipped blocks
-        imp_headers = {**headers,
-                       "Content-Type": "application/octet-stream"}
+        self.disagg.record_ship(nbytes=info["nbytes"],
+                                ms=(time.monotonic() - t0) * 1e3,
+                                chunks=info["chunks"],
+                                pipelined=bool(info.get("pipelined")
+                                               and info["chunks"]))
+        res = info.get("import") or {}
         try:
-            istatus, ihdrs, ibody = self._forward(
-                dec, "/v1/kv/import", frame, imp_headers)
-        except Exception as e:  # noqa: BLE001 — fall back to mixed
-            if isinstance(e, InjectedFault):
-                fall("ship_fault")
-            else:
-                if not self._is_timeout(e):
-                    self.pool.note_failure(dec)
-                fall("import_failed")
-            log_event(log, "kv import failed, serving mixed",
-                      replica=dec.name, error=str(e))
-            return
-        if istatus in (429, 503):
-            # decode-side backpressure (full page arena / shedding
-            # admission): the priced-shed path — honor it by NOT
-            # forcing more KV into the replica; local prefill there is
-            # charged through its own admission instead
-            fall("import_backpressure")
-            return
-        if istatus != 200:
-            fall("import_failed")
-            return
-        self.disagg.record_ship(nbytes=len(frame),
-                                ms=(time.monotonic() - t0) * 1e3)
-        try:
-            res = json.loads(ibody)
             self.disagg.record_import_result(
                 inserted=int(res.get("inserted", 0)),
                 present=int(res.get("present", 0)),
@@ -1297,6 +1656,39 @@ class FleetRouter:
 
     # -- metrics ------------------------------------------------------------
 
+    def _fold_utilization(self) -> dict:
+        """Turn the pool's time-weighted occupancy into per-class
+        busy-fraction samples (busy seconds over replicas x wall since
+        the last fold) and feed the ``fleet.disagg.util`` EWMAs — the
+        observability basis for prefill-pool sizing. Returns the raw
+        per-class occupancy snapshot for the same metrics block."""
+        totals = self.pool.busy_totals()
+        now = time.monotonic()
+        with self._util_lock:
+            prev = self._util_prev
+            wall = now - prev["t"]
+            if wall >= 0.2:  # ignore back-to-back scrapes: zero signal
+                for cls, cur in totals.items():
+                    busy_delta = cur["busy_s"] - prev["busy"].get(cls,
+                                                                  0.0)
+                    if busy_delta < 0:
+                        # a replica restarted/left between scrapes and
+                        # its accumulator reset: the class total moved
+                        # backwards. Its busy time since the reset is
+                        # the honest sample — a clamp-to-zero would
+                        # read a saturated churning class as idle.
+                        busy_delta = cur["busy_s"]
+                    self.disagg.record_util(
+                        cls, busy_delta / (max(1, cur["replicas"])
+                                           * wall))
+                self._util_prev = {
+                    "t": now,
+                    "busy": {c: v["busy_s"] for c, v in totals.items()},
+                }
+        return {cls: {"replicas": v["replicas"],
+                      "outstanding": v["outstanding"]}
+                for cls, v in sorted(totals.items())}
+
     def metrics(self) -> dict:
         # replica scrapes fan out like the pool's probes: one wedged
         # replica must cost its own timeout, not add probe_timeout
@@ -1325,8 +1717,11 @@ class FleetRouter:
         sd_total, sd_reasons = 0, {}
         # replica-side KV-ship counters (batching.disagg), aggregated so
         # "how many imports were zero-copy" is one read at the router
-        ship_agg = {"exports": 0, "export_bytes": 0, "imports": 0,
-                    "import_bytes": 0, "import_blocks_inserted": 0,
+        ship_agg = {"exports": 0, "export_bytes": 0, "export_streams": 0,
+                    "export_chunks": 0, "imports": 0,
+                    "import_bytes": 0, "import_streams": 0,
+                    "import_chunks": 0, "import_stream_aborts": 0,
+                    "import_blocks_inserted": 0,
                     "import_blocks_present": 0, "imports_zero_copy": 0,
                     "imports_assembled": 0, "import_backpressure": 0,
                     "import_rejected": 0}
@@ -1391,10 +1786,12 @@ class FleetRouter:
                     "active": len(self._session_map),
                 },
                 # phase-split serving: router-side dispatch/ship/EWMA
-                # counters + per-class membership + the replica-side
-                # export/import aggregate
+                # counters (incl. per-class busy-fraction EWMAs under
+                # "util") + live occupancy + per-class membership + the
+                # replica-side export/import aggregate
                 "disagg": {
                     **self.disagg.report(),
+                    "occupancy": self._fold_utilization(),
                     "classes": self._class_counts(),
                     "replicas": ship_agg,
                 },

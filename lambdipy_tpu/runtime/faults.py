@@ -25,6 +25,14 @@ site                      where it fires
 ``probe``                 the replica pool's per-replica health probe
 ``kv_ship``               the router's prefill→decode KV-block ship (fires
                           once per ship attempt, before the export leg)
+``kv_ship_chunk``         the router's pipelined ship relay, once per
+                          relayed KV chunk frame (an exception is a
+                          MID-STREAM transfer failure — the receiving
+                          import aborts its staged pages and the request
+                          degrades to mixed-mode; a delay is per-chunk
+                          synthetic wire time, the PR-5/PR-12 RTT idiom
+                          ``bench.py --disagg-rtt`` prices both ship
+                          modes with)
 ``session_pin``           the prefix store pinning a session's radix head
                           (fires once per turn, before any pin mutation;
                           an exception fails the pin OPEN — the turn
@@ -75,7 +83,7 @@ SITES = ("segment_dispatch", "segment_fetch", "group_prefill",
          "prefix_assemble", "prefix_walk", "transport", "page_alloc",
          # fleet-layer (router/pool) network sites
          "route_connect", "route_body", "route_latency", "probe",
-         "kv_ship", "session_pin", "session_failover")
+         "kv_ship", "kv_ship_chunk", "session_pin", "session_failover")
 KINDS = ("exception", "delay", "hang")
 _KIND_ALIASES = {"error": "exception", "raise": "exception",
                  "sleep": "delay", "stall": "delay", "block": "hang"}

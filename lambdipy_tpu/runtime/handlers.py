@@ -54,6 +54,16 @@ class HandlerState:
     # no prefix store, /v1/kv/* answers 404.
     kv_export_fn: Callable[[dict], Any] | None = None
     kv_import_fn: Callable[[bytes], dict] | None = None
+    # CHUNKED (pipelined-ship) twins: kv_export_stream_fn returns a
+    # generator of wire frames (LKVS header first, then LKVC chunks —
+    # each flushed as soon as the prefix-store walk produces its block
+    # group, so wire transfer overlaps the remaining prefill);
+    # kv_import_stream_fn consumes an iterator of raw byte chunks off
+    # a chunked-transfer request body, staging each chunk as it lands
+    # and attaching to the radix tree only on a complete stream (a
+    # truncated/garbage stream rolls back, touching nothing).
+    kv_export_stream_fn: Callable[[dict], Any] | None = None
+    kv_import_stream_fn: Callable[[Any], dict] | None = None
     # optional host-only KV presence probe ({"tokens": [...]} ->
     # {"matched": n}): the router's import-miss PULL checks it before
     # trusting a ship-dedup entry (an arena reset may have flushed the
@@ -651,8 +661,15 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
     # when automatic prefix caching does.
     kv_ship_stats = None
     kv_export = kv_import = kv_probe = None
+    kv_export_stream = kv_import_stream = None
     if prefix_store is not None:
-        from lambdipy_tpu.runtime.kvwire import decode_frame, encode_frame
+        from lambdipy_tpu.runtime.kvwire import (
+            StreamDecoder,
+            decode_frame,
+            encode_chunk,
+            encode_frame,
+            encode_stream_header,
+        )
         from lambdipy_tpu.runtime.metrics import KvShipStats
         from lambdipy_tpu.runtime.pagepool import PagesExhausted
 
@@ -712,6 +729,117 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             return {"ok": True,
                     "matched": prefix_store.present_len(list(raw)),
                     "block": prefix_store.block}
+
+        def kv_export_stream(req: dict):
+            """Chunked export twin: {"tokens": [...], "stream": true}
+            -> generator of wire frames (LKVS header, then one LKVC
+            per block group, flushed as the walk produces it). Returns
+            an error dict (the server maps dicts to 400s) when the
+            prompt has no whole block."""
+            raw = req.get("tokens")
+            if not isinstance(raw, (list, tuple)) or not raw or \
+                    not all(isinstance(t, int) for t in raw):
+                return {"ok": False,
+                        "error": "kv export wants a flat token id list"}
+            out = prefix_store.export_stream(list(raw))
+            if out is None:
+                return {"ok": False,
+                        "error": "no whole-block prefix to export"}
+            head, groups = out
+            cfg = prefix_store.server.model.cfg
+            leaves = [[name, dt.name, list(shape)]
+                      for name, (shape, dt)
+                      in sorted(prefix_store._leaf_template().items())]
+
+            def gen():
+                nbytes = sent = 0
+                header = encode_stream_header(head, prefix_store.block,
+                                              cfg.layers, leaves)
+                nbytes += len(header)
+                yield header
+                chunks = 0
+                for group in groups:
+                    frame = encode_chunk(sent, group)
+                    sent += len(group)
+                    nbytes += len(frame)
+                    chunks += 1
+                    yield frame
+                # recorded only on a COMPLETE stream: a truncated
+                # export is the relay's mid-stream-failure signal, not
+                # a served export
+                kv_ship_stats.record_export(tokens=len(head),
+                                            nbytes=nbytes,
+                                            chunks=chunks)
+
+            return gen()
+
+        def kv_import_stream(chunks_iter, commit_gate=None) -> dict:
+            """Chunked import twin: raw byte chunks off the wire ->
+            strict per-chunk validation (kvwire.StreamDecoder) ->
+            per-chunk staging -> one atomic radix attach at stream end.
+            ValueError on garbage/out-of-order/truncated streams and
+            PagesExhausted on a full arena propagate AFTER the staged
+            pages are rolled back — a failed stream touches nothing.
+
+            ``commit_gate`` (a context manager) brackets ONLY the
+            commit: the stream's staging must not hold a run slot,
+            because the body arrives over the lifetime of the exporting
+            replica's prefill — a slot held across that wait would
+            serialize the decode batch behind every in-flight ship,
+            the very stall the phase split removes. Anything the gate
+            raises aborts the staged pages like any other failure."""
+            dec = StreamDecoder()
+            imp = None
+            nbytes = chunks = 0
+            try:
+                for data in chunks_iter:
+                    nbytes += len(data)
+                    for kind, payload in dec.feed(data):
+                        if kind == "header":
+                            if payload["block"] != prefix_store.block:
+                                raise ValueError(
+                                    f"stream block width "
+                                    f"{payload['block']} != this "
+                                    f"replica's prefix block "
+                                    f"{prefix_store.block}")
+                            imp = prefix_store.import_begin(
+                                payload["tokens"])
+                        elif imp is not None:
+                            chunks += 1
+                            imp.add_blocks(payload[1])
+                if imp is None:
+                    raise ValueError("empty KV stream (no header)")
+                if not dec.complete:
+                    raise ValueError(
+                        f"truncated KV stream: "
+                        f"{dec.blocks_received} block(s) arrived")
+                if commit_gate is not None:
+                    with commit_gate:
+                        res = imp.commit()
+                else:
+                    res = imp.commit()
+            except PagesExhausted:
+                kv_ship_stats.record_backpressure()
+                kv_ship_stats.record_stream_abort()
+                if imp is not None:
+                    imp.abort()
+                raise
+            except ValueError:
+                kv_ship_stats.record_rejected()
+                kv_ship_stats.record_stream_abort()
+                if imp is not None:
+                    imp.abort()
+                raise
+            except BaseException:
+                kv_ship_stats.record_stream_abort()
+                if imp is not None:
+                    imp.abort()
+                raise
+            kv_ship_stats.record_import(
+                tokens=len(imp.row), nbytes=nbytes,
+                inserted=res["inserted"], present=res["present"],
+                mode=res["mode"], chunks=chunks)
+            return {"ok": True, **res, "streamed": True}
 
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a
@@ -1259,6 +1387,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                          if continuous is not None else None),
         kv_export_fn=kv_export,
         kv_import_fn=kv_import,
+        kv_export_stream_fn=kv_export_stream,
+        kv_import_stream_fn=kv_import_stream,
         kv_probe_fn=kv_probe,
         session_end_fn=(prefix_store.end_session
                         if prefix_store is not None else None),

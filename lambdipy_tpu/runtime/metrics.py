@@ -522,14 +522,27 @@ class KvShipStats:
     zero copies), dense-mode imports are tree slices (a hit pays a
     ``concat_cache_blocks`` assembly). ``import_backpressure`` counts
     imports refused because the page arena was full — the priced-shed
-    path the router's fallback-to-mixed rides."""
+    path the router's fallback-to-mixed rides.
+
+    The ``*_stream``/``*_chunk`` counters cover the PIPELINED (chunked)
+    ship: streamed exports/imports are the subset that rode the
+    ``LKVS``/``LKVC`` frame stream, chunk counters are the wire frames
+    flushed/received, and ``import_stream_aborts`` counts chunked
+    imports that rolled their staged pages back (truncated stream,
+    garbage chunk, dead relay) — an abort touches nothing, so it is a
+    wasted transfer, never a corrupt tree."""
 
     exports: int = 0
     export_bytes: int = 0
     export_tokens: int = 0
+    export_streams: int = 0
+    export_chunks: int = 0
     imports: int = 0
     import_bytes: int = 0
     import_tokens: int = 0
+    import_streams: int = 0
+    import_chunks: int = 0
+    import_stream_aborts: int = 0
     import_blocks_inserted: int = 0
     import_blocks_present: int = 0
     imports_zero_copy: int = 0
@@ -538,20 +551,27 @@ class KvShipStats:
     import_rejected: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
-    def record_export(self, *, tokens: int, nbytes: int) -> None:
+    def record_export(self, *, tokens: int, nbytes: int,
+                      chunks: int = 0) -> None:
         with self._lock:
             self.exports += 1
             self.export_tokens += int(tokens)
             self.export_bytes += int(nbytes)
+            if chunks:
+                self.export_streams += 1
+                self.export_chunks += int(chunks)
 
     def record_import(self, *, tokens: int, nbytes: int, inserted: int,
-                      present: int, mode: str) -> None:
+                      present: int, mode: str, chunks: int = 0) -> None:
         with self._lock:
             self.imports += 1
             self.import_tokens += int(tokens)
             self.import_bytes += int(nbytes)
             self.import_blocks_inserted += int(inserted)
             self.import_blocks_present += int(present)
+            if chunks:
+                self.import_streams += 1
+                self.import_chunks += int(chunks)
             if mode == "paged":
                 self.imports_zero_copy += 1
             else:
@@ -565,15 +585,24 @@ class KvShipStats:
         with self._lock:
             self.import_rejected += 1
 
+    def record_stream_abort(self) -> None:
+        with self._lock:
+            self.import_stream_aborts += 1
+
     def report(self) -> dict:
         with self._lock:
             return {
                 "exports": self.exports,
                 "export_bytes": self.export_bytes,
                 "export_tokens": self.export_tokens,
+                "export_streams": self.export_streams,
+                "export_chunks": self.export_chunks,
                 "imports": self.imports,
                 "import_bytes": self.import_bytes,
                 "import_tokens": self.import_tokens,
+                "import_streams": self.import_streams,
+                "import_chunks": self.import_chunks,
+                "import_stream_aborts": self.import_stream_aborts,
                 "import_blocks": {
                     "inserted": self.import_blocks_inserted,
                     "present": self.import_blocks_present,
@@ -598,11 +627,29 @@ class DisaggStats:
     shipped-key LRU). ``fallbacks`` keys every path back to MIXED-mode
     local prefill by reason — a fallback is a slower request, never a
     lost one. The byte/latency EWMAs (alpha 0.2) price the transfer the
-    way the page pool prices its backpressure."""
+    way the page pool prices its backpressure.
+
+    PIPELINED shipping: ``ships_pipelined`` counts ships that rode the
+    chunked relay (export frames pumped to the import leg while later
+    prefill chunks were still running), ``chunks_relayed`` the ``LKVC``
+    frames pumped, and ``mid_stream_failures`` ships that died AFTER
+    the stream opened (truncated export, dead import leg, injected
+    ``kv_ship_chunk`` fault) — every one also lands in ``fallbacks``
+    by reason, because a mid-stream death degrades to mixed-mode like
+    any other ship failure.
+
+    ``util`` is the per-replica-class busy-fraction EWMA (alpha 0.3)
+    the router folds from pool occupancy at scrape time — the
+    observability basis for sizing the prefill pool: a prefill class
+    pinned near 1.0 while decode idles wants more prefill replicas
+    (and vice versa)."""
 
     prefill_dispatches: int = 0
     decode_dispatches: int = 0
     ships: int = 0
+    ships_pipelined: int = 0
+    chunks_relayed: int = 0
+    mid_stream_failures: int = 0
     ship_skips: int = 0
     ship_bytes_total: int = 0
     ship_bytes_ewma: float = 0.0
@@ -612,6 +659,7 @@ class DisaggStats:
     imports_zero_copy: int = 0
     imports_assembled: int = 0
     fallbacks: dict = field(default_factory=dict)  # reason -> n
+    util: dict = field(default_factory=dict)       # class -> busy EWMA
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def count(self, counter: str, n: int = 1) -> None:
@@ -623,9 +671,17 @@ class DisaggStats:
             self.fallbacks[str(reason)] = \
                 self.fallbacks.get(str(reason), 0) + 1
 
-    def record_ship(self, *, nbytes: int, ms: float) -> None:
+    def record_ship(self, *, nbytes: int, ms: float, chunks: int = 0,
+                    pipelined: bool = False) -> None:
         with self._lock:
             self.ships += 1
+            if chunks:
+                self.chunks_relayed += int(chunks)
+            if pipelined:
+                # explicitly flagged, NOT inferred from chunks: the
+                # blocking buffer-then-relay baseline ships chunk
+                # frames too but overlaps nothing
+                self.ships_pipelined += 1
             self.ship_bytes_total += int(nbytes)
             a = 0.2
             if self.ships == 1:
@@ -636,6 +692,16 @@ class DisaggStats:
                                         + a * float(nbytes))
                 self.ship_ms_ewma = ((1 - a) * self.ship_ms_ewma
                                      + a * float(ms))
+
+    def record_util(self, cls: str, busy_frac: float) -> None:
+        """Fold one busy-fraction sample (0..1) for a replica class
+        into its EWMA — called by the router at scrape time from the
+        pool's time-weighted occupancy accounting."""
+        frac = min(1.0, max(0.0, float(busy_frac)))
+        with self._lock:
+            prev = self.util.get(str(cls))
+            self.util[str(cls)] = (frac if prev is None
+                                   else 0.7 * prev + 0.3 * frac)
 
     def record_import_result(self, *, inserted: int, present: int,
                              mode: str) -> None:
@@ -653,10 +719,15 @@ class DisaggStats:
                 "prefill_dispatches": self.prefill_dispatches,
                 "decode_dispatches": self.decode_dispatches,
                 "ships": self.ships,
+                "ships_pipelined": self.ships_pipelined,
+                "chunks_relayed": self.chunks_relayed,
+                "mid_stream_failures": self.mid_stream_failures,
                 "ship_skips": self.ship_skips,
                 "ship_bytes_total": self.ship_bytes_total,
                 "ship_bytes_ewma": round(self.ship_bytes_ewma, 1),
                 "ship_ms_ewma": round(self.ship_ms_ewma, 3),
+                "util": {cls: round(v, 4)
+                         for cls, v in sorted(self.util.items())},
                 "import_blocks": {
                     "inserted": self.import_blocks_inserted,
                     "present": self.import_blocks_present,
@@ -686,13 +757,20 @@ class SessionStats:
     ``reship_fallbacks`` keys the rest by reason — the common SIGKILL
     case is ``old_home_unreachable``: the KV died with the worker, so
     the new home's counted local re-prefill IS the recovery path.
-    ``deletes`` counts explicit ``DELETE /v1/sessions/{id}`` closes."""
+    ``deletes`` counts explicit ``DELETE /v1/sessions/{id}`` closes.
+    ``drain_reships`` counts PROACTIVE re-ships fired by a home
+    replica's ``begin_drain`` (the session's pinned head moves to its
+    rendezvous successor BEFORE the next turn arrives, so the turn
+    after a rolling restart pays a sticky hit, not a failover
+    re-prefill); their failures land in ``reship_fallbacks`` like
+    turn-time ones."""
 
     opened: int = 0
     sticky_hits: int = 0
     sticky_misses: int = 0
     failovers: int = 0
     reships: int = 0
+    drain_reships: int = 0
     deletes: int = 0
     reship_fallbacks: dict = field(default_factory=dict)  # reason -> n
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
@@ -714,6 +792,7 @@ class SessionStats:
                 "sticky_misses": self.sticky_misses,
                 "failovers": self.failovers,
                 "reships": self.reships,
+                "drain_reships": self.drain_reships,
                 "deletes": self.deletes,
                 "reship_fallbacks": dict(self.reship_fallbacks),
             }

@@ -703,33 +703,9 @@ class PrefixStore:
 
         row = [int(t) for t in tokens]
         bk = self.block
-        cfg = self.server.model.cfg
-        if not row or len(row) % bk or len(row) // bk != len(blocks):
-            raise ValueError(
-                f"import tokens ({len(row)}) must cover exactly "
-                f"{len(blocks)} x {bk}-token blocks")
-        if len(row) > cfg.max_len - bk:
-            raise ValueError(
-                f"shipped prefix of {len(row)} tokens leaves no room "
-                f"to decode in a {cfg.max_len}-token window")
-        template = self._leaf_template()
+        self._validate_import_head(row, len(blocks))
         for blk in blocks:
-            if len(blk) != cfg.layers:
-                raise ValueError(
-                    f"frame has {len(blk)} layers, server has "
-                    f"{cfg.layers}")
-            for entry in blk:
-                if set(entry) != set(template):
-                    raise ValueError(
-                        f"frame leaves {sorted(entry)} do not match "
-                        f"store layout {sorted(template)}")
-                for name, val in entry.items():
-                    shape, dt = template[name]
-                    arr = np.asarray(val)
-                    if tuple(arr.shape) != shape or arr.dtype != dt:
-                        raise ValueError(
-                            f"leaf {name!r} is {arr.dtype}{arr.shape}, "
-                            f"server stores {dt}{shape}")
+            self._validate_import_block(blk)
         with self._lock:
             self._maybe_flush_stale_locked()
             present, _ = self._present_locked(row)
@@ -747,6 +723,244 @@ class PrefixStore:
             inserted = self._insert(row, present, jblocks)
         return {"present": present // bk, "inserted": inserted,
                 "mode": mode}
+
+    def _validate_import_head(self, row: list, n_blocks: int) -> None:
+        """Import geometry checks shared by the monolithic and chunked
+        paths: whole-block coverage and room left to decode."""
+        bk = self.block
+        cfg = self.server.model.cfg
+        if not row or len(row) % bk or len(row) // bk != int(n_blocks):
+            raise ValueError(
+                f"import tokens ({len(row)}) must cover exactly "
+                f"{n_blocks} x {bk}-token blocks")
+        if len(row) > cfg.max_len - bk:
+            raise ValueError(
+                f"shipped prefix of {len(row)} tokens leaves no room "
+                f"to decode in a {cfg.max_len}-token window")
+
+    def _validate_import_block(self, blk) -> None:
+        """One block's layer/leaf layout vs this server's store
+        template — the per-chunk half of import validation."""
+        import numpy as np
+
+        cfg = self.server.model.cfg
+        template = self._leaf_template()
+        if len(blk) != cfg.layers:
+            raise ValueError(
+                f"frame has {len(blk)} layers, server has "
+                f"{cfg.layers}")
+        for entry in blk:
+            if set(entry) != set(template):
+                raise ValueError(
+                    f"frame leaves {sorted(entry)} do not match "
+                    f"store layout {sorted(template)}")
+            for name, val in entry.items():
+                shape, dt = template[name]
+                arr = np.asarray(val)
+                if tuple(arr.shape) != shape or arr.dtype != dt:
+                    raise ValueError(
+                        f"leaf {name!r} is {arr.dtype}{arr.shape}, "
+                        f"server stores {dt}{shape}")
+
+    def import_begin(self, tokens) -> "KvStreamImport":
+        """Open a CHUNKED import (the pipelined ship's receiving end):
+        validates the stream's head geometry now, hands back a
+        :class:`KvStreamImport` that stages each arriving chunk — under
+        ``--kv-paged`` the whole ship's pages are reserved up front
+        (:class:`PagesExhausted` propagates immediately as priced
+        backpressure, before any wire time is sunk) and each chunk's
+        device write runs as it arrives, overlapping the rest of the
+        transfer. NOTHING touches the radix tree until
+        :meth:`KvStreamImport.commit`; an abort (truncated stream,
+        garbage chunk, dead connection) releases every staged page and
+        leaves the tree exactly as it was."""
+        return KvStreamImport(self, tokens)
+
+    def export_stream(self, tokens):
+        """Incremental export twin of :meth:`export_blocks`: returns
+        ``(head, generator)`` — the generator yields GROUPS of numpy
+        block slices (one group per present-prefix run or cold-walk
+        chunk) as soon as each is available, so the HTTP layer can
+        flush a wire chunk while the next prefill chunk is still on the
+        device. Unlike the monolithic export, the head is FIXED up
+        front (the stream header has already been promised to the
+        wire); a mid-walk failure truncates the stream — which the
+        receiver detects by construction — instead of shrinking it.
+        Returns None when the prompt has no whole block."""
+        row = [int(t) for t in tokens]
+        cfg = self.server.model.cfg
+        bk = self.block
+        m = min((len(row) // bk) * bk, cfg.max_len - bk)
+        if m <= 0:
+            return None
+        head = row[:m]
+        return head, self._export_stream_gen(head)
+
+    def _export_stream_gen(self, head: list):
+        group = max(1, self.walk_chunk // self.block)
+        key = self.server._prefix_key(head)
+        target = len(head)
+        while True:
+            owner, waiter, pinned, kvs = False, None, [], []
+            with self._lock:
+                self._maybe_flush_stale_locked()
+                present, path = self._present_locked(head)
+                if present < target:
+                    waiter = self._inflight.get(key)
+                    if waiter is None:
+                        self._inflight[key] = threading.Event()
+                        owner = True
+                if present >= target or owner:
+                    if self.pool is not None:
+                        # pin under the validating lock (the export_blocks
+                        # rule): an LRU release-and-reuse must not swap
+                        # page content before the host read
+                        pinned = [n.page_id for n in path]
+                        self.pool.retain(pinned)
+                    else:
+                        kvs = [n.kv for n in path]
+            if present >= target:
+                try:
+                    yield from self._read_block_groups(pinned, kvs, group)
+                finally:
+                    if pinned:
+                        self.pool.release(pinned)
+                return
+            if not owner:
+                # another thread owns the walk for this very prefix:
+                # wait for it, then serve from the (now present) tree
+                if not waiter.wait(timeout=300.0):
+                    raise RuntimeError(
+                        f"prefix walk for key {key[:8]}... owned by "
+                        "another thread did not complete within 300s")
+                continue
+            try:
+                yield from self._read_block_groups(pinned, kvs, group)
+                yield from self._walk_stream(head, present, pinned, kvs)
+            finally:
+                if pinned:
+                    self.pool.release(pinned)
+                with self._lock:
+                    event = self._inflight.pop(key, None)
+                if event is not None:
+                    event.set()
+            return
+
+    def _read_block_groups(self, pinned: list, kvs: list, group: int):
+        """Yield the already-present prefix as numpy block groups —
+        paged reads ride the held refs in ``pinned``, dense reads the
+        python refs in ``kvs``."""
+        import numpy as np
+
+        if self.pool is not None:
+            if not pinned:
+                return
+            from lambdipy_tpu.models.llama import arena_page_slices
+
+            with self.pool.arena_lock:
+                arena = self.pool.ensure_arena()
+            for i in range(0, len(pinned), group):
+                yield [arena_page_slices(arena, pid, self.pool.page)
+                       for pid in pinned[i:i + group]]
+        else:
+            for i in range(0, len(kvs), group):
+                yield [[{name: np.asarray(val)
+                         for name, val in entry.items()}
+                        for entry in kv] for kv in kvs[i:i + group]]
+
+    def _walk_stream(self, row: list, matched: int, pinned: list,
+                     kvs: list):
+        """The cold-walk tail of a streamed export: mirrors
+        :meth:`_walk` chunk for chunk, but yields each chunk's block
+        slices (as numpy, wire-ready) the moment the chunk program
+        returns — and inserts them into the tree best-effort along the
+        way (the export IS the prefill, exactly like the monolithic
+        path; a failed insert caches less, it never fails the ship)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from lambdipy_tpu.models.llama import (
+            concat_cache_blocks,
+            copy_cache,
+            slice_cache_blocks,
+        )
+
+        server = self.server
+        cfg = server.model.cfg
+        bk = self.block
+        target = len(row)
+
+        def emit(cache, lo: int, hi: int):
+            jb = [slice_cache_blocks(cache, p, bk)
+                  for p in range(lo, hi, bk)]
+            try:
+                if self.pool is not None:
+                    self._insert_paged(row, lo, jb)
+                else:
+                    self._insert(row, lo, jb)
+            except Exception as e:  # noqa: BLE001 — cache less, ship on
+                log.error("streamed export insert failed (caching "
+                          "less): %s", e)
+            return [[{name: np.asarray(val)
+                      for name, val in entry.items()}
+                     for entry in blk] for blk in jb]
+
+        with server._mesh_ctx():
+            if matched == 0:
+                fw = self.walk_chunk if target >= self.walk_chunk else bk
+                pf = server._prefix_first_fn(fw, cfg.max_len)
+                prompt_op, _ = server._pad_rows([row[:fw]], [fw], 1, fw)
+                self._walk_fault()
+                cache = pf(server.params, prompt_op, jnp.int32(fw))
+                pos = fw
+                yield emit(cache, 0, fw)
+            elif self.pool is not None:
+                gather = server._paged_gather_fn(
+                    self.pool.n_pages, self.pool.page, cfg.max_len)
+                tbl = np.zeros((1, cfg.max_len // bk), np.int32)
+                tbl[0, :len(pinned)] = pinned
+                with self.pool.arena_lock:
+                    arena = self.pool.ensure_arena()
+                    cache = gather(arena, jnp.asarray(tbl),
+                                   jnp.int32(matched))
+                pos = matched
+            else:
+                entry = server.get_prefix(
+                    server._prefix_key(row[:matched]))
+                if entry is not None:
+                    # the ext loop DONATES its cache argument; the LRU's
+                    # copy must stay live for concurrent readers
+                    cache = copy_cache(entry[0])
+                else:
+                    cache = concat_cache_blocks(cfg, kvs, cfg.max_len)
+                    self.stats_counters.record_assembly(
+                        _cache_bytes(cache))
+                pos = matched
+            wk = self.walk_chunk
+            ext = server._prefix_ext_fn(bk)
+            ext_wide = server._prefix_ext_fn(wk) if wk > bk else None
+            while pos < target:
+                self._walk_fault()
+                if (ext_wide is not None and target - pos >= wk
+                        and pos + wk <= cfg.max_len):
+                    chunk_op, _ = server._pad_rows(
+                        [row[pos:pos + wk]], [wk], 1, wk)
+                    cache = ext_wide(server.params, cache, chunk_op,
+                                     jnp.int32(wk))
+                    yield emit(cache, pos, pos + wk)
+                    pos += wk
+                else:
+                    chunk_op, _ = server._pad_rows(
+                        [row[pos:pos + bk]], [bk], 1, bk)
+                    cache = ext(server.params, cache, chunk_op,
+                                jnp.int32(bk))
+                    yield emit(cache, pos, pos + bk)
+                    pos += bk
+            if self.pool is None:
+                # register the full cache like _walk does, so the next
+                # local hit on this prefix skips reassembly
+                server.register_prefix(server._prefix_key(row), cache,
+                                       target)
 
     # -- assembly / extension ------------------------------------------------
 
@@ -1004,6 +1218,18 @@ class PrefixStore:
             pool.release([p for p in pre if p not in staged])
             pool.release(staged)
             raise
+        return self._attach_paged(row, start, staged, gen)
+
+    def _attach_paged(self, row: list, start: int, staged: list,
+                      gen: int) -> int:
+        """Attach already-staged (allocated + written) arena pages as
+        tree nodes under the matched path — the commit half of
+        :meth:`_insert_paged`, shared with the chunked KV-import path,
+        whose pages stage one wire chunk at a time. Ownership of every
+        page in ``staged`` transfers HERE: each either becomes a
+        store-owned node or is released (racer duplicates, a vanished
+        base path, an arena reset since ``gen``)."""
+        pool, bk = self.pool, self.block
         attached: set[int] = set()
         with self._lock:
             self._maybe_flush_stale_locked()
@@ -1148,3 +1374,139 @@ class PrefixStore:
         except Exception:  # noqa: BLE001 — stats must never break /metrics
             pass
         return out
+
+
+class KvStreamImport:
+    """One chunked KV import in flight (see
+    :meth:`PrefixStore.import_begin`). Lifecycle::
+
+        imp = store.import_begin(tokens)     # geometry + page reservation
+        imp.add_blocks(blocks)               # per wire chunk: validate + stage
+        res = imp.commit()                   # attach to the tree, atomically
+        imp.abort()                          # any failure: release, touch nothing
+
+    Staging is the device half (page writes / host->jnp conversion) and
+    runs per chunk, overlapping the remaining wire transfer; the radix
+    tree is only mutated at :meth:`commit`, so a truncated or garbage
+    stream rolls back to exactly the pre-stream state — the router's
+    ship-dedup LRU can never be told about blocks that half-arrived."""
+
+    def __init__(self, store: PrefixStore, tokens):
+        self.store = store
+        self.row = [int(t) for t in tokens]
+        bk = store.block
+        self.n_blocks = len(self.row) // bk if self.row else 0
+        store._validate_import_head(self.row, self.n_blocks)
+        with store._lock:
+            store._maybe_flush_stale_locked()
+            present, _ = store._present_locked(self.row)
+        self.present = present          # tokens already in the tree
+        self.received = 0               # blocks fed so far (incl. present)
+        self.closed = False
+        self._jblocks: list = []        # dense staging
+        self._pages: list[int] = []     # paged staging (pre-reserved)
+        self._written = 0
+        self._gen = 0
+        self._write = None
+        pool = store.pool
+        if pool is not None:
+            n_new = self.n_blocks - present // bk
+            self._gen = pool.arena_generation
+            self._write = store.server._page_write_fn(pool.n_pages,
+                                                      pool.page)
+            if n_new > 0:
+                # reserve the WHOLE ship before any wire time is spent
+                # on it: a full arena must surface as backpressure now
+                # (PagesExhausted -> the priced 503), not as a half-
+                # staged stream later. record_shed=False — the router's
+                # fallback counter owns this failure mode.
+                self._pages = pool.alloc(n_new, tokens=n_new * bk,
+                                         record_shed=False)
+
+    def add_blocks(self, blocks) -> None:
+        """Stage one wire chunk's blocks (arriving strictly in block
+        order — the stream decoder enforces it). Blocks the tree
+        already held at begin are skipped; the rest stage into their
+        reserved pages (paged) or convert for insertion (dense)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        if self.closed:
+            raise ValueError("KV stream import already closed")
+        store, bk = self.store, self.store.block
+        if self.received + len(blocks) > self.n_blocks:
+            raise ValueError(
+                f"KV stream overruns its header: {self.received} + "
+                f"{len(blocks)} > {self.n_blocks} blocks")
+        for blk in blocks:
+            store._validate_import_block(blk)
+            idx = self.received
+            self.received += 1
+            if idx * bk < self.present:
+                continue  # already present at begin: idempotent skip
+            jb = [{name: jnp.asarray(np.asarray(val))
+                   for name, val in entry.items()} for entry in blk]
+            pool = store.pool
+            if pool is None:
+                self._jblocks.append(jb)
+                continue
+            pid = self._pages[self._written]
+            with pool.arena_lock:
+                arena = pool.ensure_arena()
+                pool.arena = self._write(arena, jnp.int32(pid), jb)
+            self._written += 1
+
+    @property
+    def complete(self) -> bool:
+        return self.received >= self.n_blocks
+
+    def commit(self) -> dict:
+        """Attach every staged block under the matched path — the same
+        idempotent insert the monolithic import performs. Refuses (and
+        rolls back) an incomplete stream: committing a half-arrived
+        head would be exactly the silent partial insert the staged
+        design exists to prevent."""
+        store, bk = self.store, self.store.block
+        if self.closed:
+            raise ValueError("KV stream import already closed")
+        if not self.complete:
+            got = self.received
+            self.abort()
+            raise ValueError(
+                f"truncated KV stream: {got} of {self.n_blocks} "
+                f"block(s) arrived")
+        self.closed = True
+        mode = "paged" if store.pool is not None else "dense"
+        try:
+            if store.pool is not None:
+                # ownership of the staged pages transfers to the attach
+                # (store nodes or released as racer duplicates)
+                inserted = store._attach_paged(self.row, self.present,
+                                               self._pages, self._gen)
+                self._pages = []
+            elif self._jblocks:
+                inserted = store._insert(self.row, self.present,
+                                         self._jblocks)
+            else:
+                inserted = 0
+        except Exception:
+            self._release()
+            raise
+        return {"present": self.present // bk, "inserted": inserted,
+                "mode": mode}
+
+    def abort(self) -> None:
+        """Release every staged page and forget the staging — the tree
+        (and the pool's accounting) read as if the stream never
+        started. Idempotent; safe after commit."""
+        if self.closed:
+            return
+        self.closed = True
+        self._release()
+
+    def _release(self) -> None:
+        pool = self.store.pool
+        if pool is not None and self._pages:
+            pool.release(self._pages)
+        self._pages = []
+        self._jblocks = []

@@ -664,6 +664,11 @@ class BundleServer:
                 if request is None:
                     server_self.stats.record_error()
                     return
+                stream_fn = getattr(server_self.boot.state,
+                                    "kv_export_stream_fn", None)
+                if request.get("stream") and stream_fn is not None:
+                    self._kv_export_stream(stream_fn, request)
+                    return
                 if fn is None:
                     self._send(404, {"ok": False, "error":
                                      "no KV export surface (prefix "
@@ -715,12 +720,169 @@ class BundleServer:
                 finally:
                     self._end_invoke(ticket, t0)
 
+            def _kv_export_stream(self, stream_fn, request: dict):
+                """Chunked (pipelined-ship) export: one HTTP chunk per
+                wire frame, flushed as soon as the prefix-store walk
+                produces its block group — the router's relay reads
+                frame k while this replica prefills chunk k+1. Same
+                admission bracket as the monolithic export (the export
+                IS the request's prefill). A mid-walk failure after
+                headers are committed TRUNCATES the stream (no terminal
+                chunk): the receiver's block accounting makes
+                truncation self-evident, so there is no honest 500 left
+                to send and no dishonest clean EOF sent instead."""
+                ticket = self._begin_invoke(request)
+                if ticket is None:
+                    return
+                t0 = time.monotonic()
+                committed = False
+                try:
+                    gen = stream_fn(request)
+                    if isinstance(gen, dict):  # handler-level refusal
+                        self._send(400, gen)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-lkv-stream")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    committed = True
+                    for frame in gen:
+                        if not self._write_frame(frame):
+                            return  # client gone; generator closed
+                    server_self.stats.record(
+                        (time.monotonic() - t0) * 1e3)
+                    self._end_frames()
+                except Exception as e:  # noqa: BLE001
+                    server_self.stats.record_error()
+                    log_event(log, "kv export stream failed",
+                              error=str(e), kind=type(e).__name__)
+                    if not committed:
+                        self._send(500, {"ok": False, "error": str(e),
+                                         "kind": type(e).__name__})
+                    else:
+                        self.close_connection = True
+                finally:
+                    self._end_invoke(ticket, t0)
+
+            def _read_chunked_body(self):
+                """Generator over a chunked-transfer request body's
+                chunks (stdlib BaseHTTPRequestHandler does not de-chunk
+                requests). A malformed framing line raises ValueError;
+                a connection dying mid-chunk raises ConnectionError —
+                both roll the streaming import back."""
+                while True:
+                    line = self.rfile.readline(66)
+                    if not line:
+                        raise ConnectionError(
+                            "connection closed mid-chunk-stream")
+                    size = int(line.strip().split(b";")[0], 16)
+                    if size == 0:
+                        self.rfile.readline()  # trailing CRLF
+                        return
+                    data = self.rfile.read(size)
+                    if len(data) < size:
+                        raise ConnectionError(
+                            "connection closed mid-chunk")
+                    self.rfile.read(2)  # chunk CRLF
+                    yield data
+
+            def _kv_import_stream(self, stream_fn):
+                """Chunked (pipelined-ship) import: each arriving frame
+                stages immediately (device page writes overlap the rest
+                of the transfer); the radix tree is only touched when
+                the complete stream commits. Any failure — truncated
+                body, garbage chunk, full arena — rolls the staged
+                pages back and the tree reads as if the stream never
+                happened.
+
+                Admission brackets ONLY the commit (via the gate the
+                handler honors): the body arrives over the exporting
+                replica's prefill, and a run slot held across that wait
+                would serialize this replica's decode batch behind
+                every in-flight ship. Staging is backpressured by the
+                page arena itself (strict up-front reservation), not by
+                the scheduler."""
+                t0 = time.monotonic()
+
+                class _CommitShed(Exception):
+                    pass
+
+                handler = self
+
+                class _Gate:
+                    def __enter__(gate):
+                        gate.ticket = handler._begin_invoke(None)
+                        if gate.ticket is None:
+                            # _begin_invoke already sent the priced 503
+                            raise _CommitShed()
+                        gate.t0 = time.monotonic()
+                        return gate
+
+                    def __exit__(gate, *exc):
+                        handler._end_invoke(gate.ticket, gate.t0)
+                        return False
+
+                try:
+                    out = stream_fn(self._read_chunked_body(),
+                                    commit_gate=_Gate())
+                except _CommitShed:
+                    self.close_connection = True  # shed already sent
+                    return
+                except PagesExhausted as e:
+                    cls = (self.headers.get("x-priority")
+                           or "interactive").strip().lower()
+                    server_self.sched.admission.count_shed(
+                        "kv_import", cls)
+                    self.close_connection = True
+                    self._send_shed(
+                        Shed(503, "kv_import", e.retry_after_s))
+                    return
+                except ValueError as e:
+                    self.close_connection = True
+                    self._send(400, {"ok": False,
+                                     "error": f"bad KV stream: {e}"})
+                    return
+                except ConnectionError as e:
+                    # the relay died mid-stream: staged pages are
+                    # already rolled back; there is nobody left to
+                    # answer
+                    log_event(log, "kv import stream died",
+                              error=str(e))
+                    self.close_connection = True
+                    return
+                except Exception as e:  # noqa: BLE001
+                    server_self.stats.record_error()
+                    log_event(log, "kv import stream failed",
+                              error=str(e), kind=type(e).__name__)
+                    self.close_connection = True
+                    self._send(500, {"ok": False, "error": str(e),
+                                     "kind": type(e).__name__})
+                    return
+                server_self.stats.record((time.monotonic() - t0) * 1e3)
+                self._send(200, out)
+
             def _kv_import(self):
                 """Disaggregated-serving import: a shipped KV frame
                 becomes a radix insert. A full page arena answers the
                 priced-shed 503 (reason ``kv_import``) so the router
                 falls back to mixed-mode local prefill; a malformed
-                frame is a 400 and touches nothing."""
+                frame is a 400 and touches nothing. A CHUNKED request
+                body routes to the streaming twin."""
+                te = (self.headers.get("Transfer-Encoding")
+                      or "").lower()
+                stream_fn = getattr(server_self.boot.state,
+                                    "kv_import_stream_fn", None)
+                if "chunked" in te:
+                    if stream_fn is None:
+                        self.close_connection = True  # unread body
+                        self._send(404, {"ok": False, "error":
+                                         "no chunked KV import surface "
+                                         "(prefix cache off or "
+                                         "unsupported handler)"})
+                        return
+                    self._kv_import_stream(stream_fn)
+                    return
                 fn = getattr(server_self.boot.state, "kv_import_fn", None)
                 # consume the body before any early reply: on keep-alive
                 # the unread frame bytes would parse as the next request

@@ -51,7 +51,10 @@
 # seeded-sampled, dense + paged KV, real KV ships observed), decode
 # tok/s under a concurrent cold-prefill burst >= 1.2x the mixed fleet
 # at equal replica count, and an injected kv_ship failure completing
-# the whole burst bitwise with zero client-visible errors.
+# the whole burst bitwise with zero client-visible errors. Phase 12b
+# adds the synthetic-RTT axis (bench.py --disagg-rtt): pipelined-ship
+# TTFT <= 0.6x the blocking ship's at 66 ms per relayed chunk, and
+# bitwise zero-error delivery under permanent mid-stream chunk failure.
 #
 # Phase 13 is the MULTI-TURN SESSION sweep (bench.py --sessions,
 # subprocess replicas behind the sticky-session router): bitwise
@@ -248,6 +251,22 @@ if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 12"
+
+# Phase 12b: the synthetic-RTT axis of the same split (bench.py
+# --disagg-rtt) — every relayed KV chunk pays 66 ms through the
+# kv_ship_chunk delay site and every cold-walk chunk 66 ms through
+# prefix_walk, so the pipelined (chunked, windowed) ship must land
+# cold-request TTFT <= 0.6x the blocking buffer-then-relay ship's
+# (transfer hidden under prefill), and a permanent mid-stream chunk
+# failure must deliver every request bitwise with zero client errors
+# and no ship-dedup poisoning.
+phase_begin "phase 12b: pipelined-ship RTT sweep (bench.py --disagg-rtt)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --disagg-rtt; then
+    echo "FATAL: bench.py --disagg-rtt sweep failed" >&2
+    exit 1
+fi
+phase_end "phase 12b"
 
 # Phase 13: multi-turn sessions — bench.py --sessions exits nonzero if
 # any conversation turn diverges bitwise from the direct single-server

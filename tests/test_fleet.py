@@ -134,6 +134,12 @@ class StubReplica:
                              "sp_standdown_reasons": {}}}
         self.cfg["kv_shed"] = False   # /v1/kv/import answers 503
         self.cfg["kv_frame"] = b"LKV1-stub-frame"  # /v1/kv/export body
+        # opt-in chunked export: a list of wire frames (LKVS header +
+        # LKVC chunks, e.g. from kvwire.encode_stream) served as a
+        # chunked response when the export request asks stream=true —
+        # the pipelined-relay tests ride this; None keeps the
+        # monolithic LKV1 behavior above
+        self.cfg["kv_stream_frames"] = None
         # /v1/kv/probe: None = report the whole asked head as present
         # (the dedup-preserving default); an int scripts a partial/empty
         # match (a stale ship-dedup entry the router should PULL for)
@@ -183,8 +189,30 @@ class StubReplica:
                 self.wfile.write(f"{len(b):x}\r\n".encode() + b + b"\r\n")
 
             def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
-                raw = self.rfile.read(length)
+                if "chunked" in (self.headers.get("Transfer-Encoding")
+                                 or "").lower():
+                    # de-chunk a streamed import body (the pipelined
+                    # relay's import leg); the reassembled bytes land
+                    # in stub.imports like a monolithic frame would. A
+                    # relay dying mid-stream (no terminal chunk) closes
+                    # the connection without recording an import — the
+                    # rollback behavior the real server implements.
+                    raw = b""
+                    try:
+                        while True:
+                            size = int(
+                                self.rfile.readline(66).strip(), 16)
+                            if size == 0:
+                                self.rfile.readline()
+                                break
+                            raw += self.rfile.read(size)
+                            self.rfile.read(2)
+                    except (ValueError, OSError):
+                        self.close_connection = True
+                        return
+                else:
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length)
                 if self.path == "/v1/kv/import":
                     # binary frame, not JSON; scriptable backpressure
                     if stub.cfg["kv_shed"]:
@@ -216,6 +244,17 @@ class StubReplica:
                                    {"Retry-After": str(ra)})
                         return
                     stub.exports += 1
+                    frames = stub.cfg["kv_stream_frames"]
+                    if body.get("stream") and frames is not None:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-lkv-stream")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        for f in frames:
+                            self._frame(f)
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
                     frame = stub.cfg["kv_frame"]
                     self.send_response(200)
                     self.send_header("Content-Type",

@@ -958,6 +958,105 @@ def test_parse_attach_spec_keeps_odd_urls():
         ("a", "http://h:8080/base", MIXED)
 
 
+def _stub_stream_frames(n_blocks=3, block=4):
+    """A valid LKVS/LKVC stream (tiny fake KV) a stub export serves."""
+    import numpy as np
+
+    from lambdipy_tpu.runtime import kvwire
+
+    rng = np.random.default_rng(0)
+    blocks = [[{"k": rng.random((1, block, 2, 4)).astype(np.float32),
+                "v": rng.random((1, block, 2, 4)).astype(np.float32)}
+               for _ in range(2)] for _ in range(n_blocks)]
+    return kvwire.encode_stream(list(range(n_blocks * block)), block,
+                                blocks, group=1)
+
+
+def test_kv_ship_chunk_fault_degrades_and_never_poisons_dedup(
+        disagg_pair):
+    """An injected mid-stream chunk failure: the request still delivers
+    (mixed-mode fallback, counted by reason), NOTHING half-arrived is
+    recorded on the decode side, and the ship-dedup LRU is not marked —
+    the next request on the same prefix re-ships, and with the fault
+    exhausted that ship lands bitwise."""
+    dec, pre, pool = disagg_pair
+    frames = _stub_stream_frames()
+    pre.cfg["kv_stream_frames"] = frames
+    plan = FaultPlan.from_spec("kv_ship_chunk:exception@seg=2,n=1")
+    router = _router(pool, faults=plan)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        row = list(range(1, 13))
+        out = _post(f"{base}/invoke", {"tokens": row,
+                                       "max_new_tokens": 2})
+        assert out["ok"] and out["replica"] == "dec"  # delivered
+        rep = router.disagg.report()
+        assert rep["fallbacks"].get("ship_chunk_fault") == 1
+        assert rep["mid_stream_failures"] >= 1
+        assert rep["ships"] == 0
+        assert dec.imports == []  # the aborted stream recorded nothing
+        assert pre.exports == 1
+        # same prefix again: the dedup LRU must NOT claim it shipped —
+        # the relay re-ships, and (fault spent) delivers every frame
+        out = _post(f"{base}/invoke", {"tokens": row,
+                                       "max_new_tokens": 2})
+        assert out["ok"]
+        assert pre.exports == 2
+        assert dec.imports == [b"".join(frames)]  # bitwise delivery
+        rep = router.disagg.report()
+        assert rep["ships"] == 1 and rep["ships_pipelined"] == 1
+        assert rep["chunks_relayed"] == len(frames) - 1
+        # and NOW the dedup holds: a third request skips the ship
+        _post(f"{base}/invoke", {"tokens": row, "max_new_tokens": 2})
+        assert pre.exports == 2
+        assert router.disagg.report()["ship_skips"] == 1
+    finally:
+        router.stop()
+
+
+def test_kv_ship_chunk_delay_prices_the_relay(disagg_pair):
+    """Per-chunk synthetic RTT (the delay kind) slows but never breaks
+    the ship: delivered bitwise, EWMA prices the wire time."""
+    dec, pre, pool = disagg_pair
+    frames = _stub_stream_frames()
+    pre.cfg["kv_stream_frames"] = frames
+    plan = FaultPlan.from_spec("kv_ship_chunk:delay@ms=40,n=inf")
+    router = _router(pool, faults=plan)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        t0 = time.monotonic()
+        out = _post(f"{base}/invoke", {"tokens": list(range(1, 13)),
+                                       "max_new_tokens": 2})
+        assert out["ok"]
+        assert dec.imports == [b"".join(frames)]
+        rep = router.disagg.report()
+        assert rep["ships"] == 1 and rep["chunks_relayed"] == 3
+        assert rep["mid_stream_failures"] == 0
+        assert rep["ship_ms_ewma"] >= 3 * 40
+        assert time.monotonic() - t0 >= 0.12
+    finally:
+        router.stop()
+
+
+def test_monolithic_ship_window_zero_uses_single_frame(disagg_pair):
+    """ship_window=0 is the pre-chunking behavior: one LKV1 frame, no
+    chunk relay, the kv_ship_chunk site never fires."""
+    dec, pre, pool = disagg_pair
+    plan = FaultPlan.from_spec("kv_ship_chunk:exception@seg=1,n=inf")
+    router = _router(pool, ship_window=0, faults=plan)
+    try:
+        out = _post(f"http://127.0.0.1:{router.port}/invoke",
+                    {"tokens": list(range(1, 13)), "max_new_tokens": 2})
+        assert out["ok"]
+        assert dec.imports == [pre.cfg["kv_frame"]]
+        rep = router.disagg.report()
+        assert rep["ships"] == 1 and rep["ships_pipelined"] == 0
+        assert rep["chunks_relayed"] == 0
+        assert plan.counts().get("kv_ship_chunk") is None
+    finally:
+        router.stop()
+
+
 def test_ship_skips_breaker_blocked_decode_target(disagg_pair):
     """An open decode-replica breaker shields it from ships too — the
     ship must target the replica the forward will actually pick."""

@@ -416,3 +416,90 @@ def test_pull_failure_counts_pull_failed(disagg_pair):
         assert rep["fallbacks"].get("export_shed") == 1
     finally:
         router.stop()
+
+
+# -- proactive re-ship on drain ----------------------------------------------
+
+
+def test_drain_reships_session_proactively():
+    """begin_drain on a session's home moves the pinned head to its
+    rendezvous successor THROUGH the ship legs before any /shutdown —
+    the next turn pays a sticky hit on the new home, not a failover."""
+    stubs = {n: StubReplica(n) for n in ("r0", "r1", "r2")}
+    pool = ReplicaPool(probe_interval=5.0, fail_threshold=1,
+                       readmit_passes=2, probe_timeout=2.0)
+    for n, s in stubs.items():
+        pool.attach(n, s.url)
+    pool.probe_all()
+    router = _router(pool)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        row = list(range(1, 13))
+        home = _turn(base, "conv-drain", row)["replica"]
+        # stubs attach unmanaged; the drain contract is managed-only —
+        # flip the flag so begin_drain accepts the stand-in
+        pool.replicas[home].managed = True
+        pool.begin_drain(home)  # fires the on_drain hook synchronously
+        rep = router.metrics()["fleet"]["sessions"]
+        assert rep["drain_reships"] == 1
+        assert rep["reship_fallbacks"] == {}
+        assert rep["failovers"] == 0  # proactive, not turn-time
+        assert stubs[home].exports == 1  # export hit the DRAINING home
+        importers = [n for n in stubs
+                     if n != home and stubs[n].imports]
+        assert len(importers) == 1
+        new_home = importers[0]
+        assert stubs[new_home].imports == [stubs[home].cfg["kv_frame"]]
+        # the very next turn lands sticky on the new home — no
+        # failover, no re-prefill detour through the sticky-miss path
+        out = _turn(base, "conv-drain", row + [9] * 4)
+        assert out["replica"] == new_home
+        rep = router.metrics()["fleet"]["sessions"]
+        assert rep["failovers"] == 0 and rep["sticky_hits"] >= 1
+    finally:
+        router.stop()
+        pool.close()
+        for s in stubs.values():
+            try:
+                s.kill()
+            except Exception:
+                pass
+
+
+def test_drain_reship_failure_leaves_turn_time_failover():
+    """A failed drain re-ship (successor import shedding) must NOT
+    re-home the record: the next turn takes the normal failover path
+    and still serves."""
+    stubs = {n: StubReplica(n) for n in ("r0", "r1")}
+    pool = ReplicaPool(probe_interval=5.0, fail_threshold=1,
+                       readmit_passes=2, probe_timeout=2.0)
+    for n, s in stubs.items():
+        pool.attach(n, s.url)
+    pool.probe_all()
+    router = _router(pool)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        row = list(range(1, 13))
+        home = _turn(base, "conv-drain2", row)["replica"]
+        other = next(n for n in stubs if n != home)
+        stubs[other].cfg["kv_shed"] = True  # successor arena "full"
+        pool.replicas[home].managed = True
+        pool.begin_drain(home)
+        rep = router.metrics()["fleet"]["sessions"]
+        assert rep["drain_reships"] == 0
+        assert rep["reship_fallbacks"].get("import_backpressure") == 1
+        # the record still points at the draining home, so the next
+        # turn fails over (and serves) through the turn-time path
+        stubs[other].cfg["kv_shed"] = False
+        out = _turn(base, "conv-drain2", row + [9] * 4)
+        assert out["ok"] and out["replica"] == other
+        rep = router.metrics()["fleet"]["sessions"]
+        assert rep["failovers"] == 1
+    finally:
+        router.stop()
+        pool.close()
+        for s in stubs.values():
+            try:
+                s.kill()
+            except Exception:
+                pass

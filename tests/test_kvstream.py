@@ -1,0 +1,454 @@
+"""Chunked (pipelined-ship) KV streaming: the LKVS/LKVC wire format
+(runtime/kvwire.py), the prefix store's incremental export and staged
+chunked import, and their rollback guarantees.
+
+The acceptance bar extends test_kvship.py's: a chunked stream must be
+BITWISE the monolithic frame's payload (float / int8+scales / bf16), a
+truncated, reordered, or garbage chunk must be rejected before the
+radix tree is touched, and a mid-stream abort must return every staged
+page — ``check_invariants()`` plus pinned/staged accounting back to
+exactly zero."""
+
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models.llama import init_page_arena, page_kv_bytes
+from lambdipy_tpu.runtime import kvwire
+from lambdipy_tpu.runtime.kvwire import (
+    FrameSplitter,
+    StreamDecoder,
+    decode_frame,
+    decode_stream,
+    encode_chunk,
+    encode_frame,
+    encode_stream,
+    encode_stream_header,
+)
+from lambdipy_tpu.runtime.pagepool import (
+    PagePool,
+    PagesExhausted,
+    page_width,
+)
+from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+BLOCK = 16
+
+
+@pytest.fixture(scope="module")
+def tiny_server():
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    return adapter.make_server(params)
+
+
+def mk_pool(server, *, n_windows=4, extra_pages=0, block=BLOCK):
+    cfg = server.model.cfg
+    page = page_width(cfg.max_len, block)
+    n_pages = n_windows * (cfg.max_len // page) + 1 + extra_pages
+    return PagePool(n_pages=n_pages, page=page,
+                    page_bytes=page_kv_bytes(cfg, page),
+                    make_arena=lambda: init_page_arena(cfg, n_pages,
+                                                       page))
+
+
+def _fake_blocks(n_blocks, layers=2, dtype=np.float32, int8=False,
+                 seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_blocks):
+        blk = []
+        for _ in range(layers):
+            if int8:
+                blk.append({
+                    "k_int8": rng.integers(-127, 127, (1, BLOCK, 2, 4),
+                                           dtype=np.int8),
+                    "k_scale": rng.random((1, BLOCK, 2, 1),
+                                          dtype=np.float32),
+                    "v_int8": rng.integers(-127, 127, (1, BLOCK, 2, 4),
+                                           dtype=np.int8),
+                    "v_scale": rng.random((1, BLOCK, 2, 1),
+                                          dtype=np.float32),
+                })
+            else:
+                blk.append({
+                    "k": rng.random((1, BLOCK, 2, 4)).astype(dtype),
+                    "v": rng.random((1, BLOCK, 2, 4)).astype(dtype),
+                })
+        out.append(blk)
+    return out
+
+
+def _assert_blocks_equal(a, b):
+    assert len(a) == len(b)
+    for b1, b2 in zip(a, b):
+        for e1, e2 in zip(b1, b2):
+            assert set(e1) == set(e2)
+            for name in e1:
+                x, y = np.asarray(e1[name]), np.asarray(e2[name])
+                assert x.dtype == y.dtype
+                if x.dtype.kind == "V" or x.dtype.itemsize == 2:
+                    np.testing.assert_array_equal(x.view(np.uint16),
+                                                  y.view(np.uint16))
+                else:
+                    np.testing.assert_array_equal(x, y)
+
+
+# -- wire format: stream vs monolithic parity --------------------------------
+
+
+@pytest.mark.parametrize("int8", [False, True])
+@pytest.mark.parametrize("group", [1, 2, 5])
+def test_stream_roundtrip_bitwise_matches_monolithic(int8, group):
+    """A chunked stream decodes to the exact arrays the monolithic
+    LKV1 frame carries — any group size, float and int8+scales."""
+    blocks = _fake_blocks(5, int8=int8)
+    tokens = list(range(5 * BLOCK))
+    t_m, bk_m, out_m = decode_frame(encode_frame(tokens, BLOCK, blocks))
+    frames = encode_stream(tokens, BLOCK, blocks, group=group)
+    t_s, bk_s, out_s = decode_stream(frames)
+    assert t_s == t_m == tokens and bk_s == bk_m == BLOCK
+    _assert_blocks_equal(out_m, out_s)
+    _assert_blocks_equal(blocks, out_s)
+
+
+def test_stream_roundtrip_bfloat16():
+    import ml_dtypes
+
+    blocks = _fake_blocks(3, dtype=ml_dtypes.bfloat16)
+    frames = encode_stream(list(range(3 * BLOCK)), BLOCK, blocks,
+                           group=2)
+    _, _, out = decode_stream(frames)
+    assert out[0][0]["k"].dtype == ml_dtypes.bfloat16
+    _assert_blocks_equal(blocks, out)
+
+
+def test_splitter_reframes_any_byte_chunking():
+    """The relay-side splitter recovers exact frame boundaries from an
+    arbitrarily re-chunked byte stream (what urllib hands a reader)."""
+    blocks = _fake_blocks(4)
+    frames = encode_stream(list(range(4 * BLOCK)), BLOCK, blocks,
+                           group=3)
+    blob = b"".join(frames)
+    for step in (1, 7, 64, len(blob)):
+        sp = FrameSplitter()
+        got = []
+        for i in range(0, len(blob), step):
+            got.extend(sp.feed(blob[i:i + step]))
+        assert sp.complete
+        assert [k for k, _ in got] == ["header"] + \
+            ["chunk"] * (len(frames) - 1)
+        assert b"".join(f for _, f in got) == blob
+
+
+# -- wire format: rejection matrix -------------------------------------------
+
+
+def test_stream_rejects_truncation_and_reorder():
+    blocks = _fake_blocks(4)
+    frames = encode_stream(list(range(4 * BLOCK)), BLOCK, blocks,
+                           group=1)
+    with pytest.raises(ValueError, match="truncated"):
+        decode_stream(frames[:-1])
+    with pytest.raises(ValueError, match="out of order"):
+        decode_stream([frames[0], frames[2], frames[1], frames[3],
+                       frames[4]])
+    # a replayed (duplicate) chunk is out of order too
+    with pytest.raises(ValueError, match="out of order"):
+        decode_stream([frames[0], frames[1], frames[1]])
+
+
+def test_stream_rejects_garbage_frames():
+    blocks = _fake_blocks(2)
+    frames = encode_stream(list(range(2 * BLOCK)), BLOCK, blocks,
+                           group=1)
+    # stream must open with the LKVS header
+    with pytest.raises(ValueError, match="open with"):
+        decode_stream(frames[1:])
+    # chunk magic lies
+    bad = b"NOPE" + frames[1][4:]
+    with pytest.raises(ValueError, match="magic"):
+        decode_stream([frames[0], bad])
+    # chunk body length lies vs the leaf template
+    import json as _json
+    import struct as _struct
+
+    hlen = _struct.unpack_from("<I", frames[1], 4)[0]
+    hdr = _json.loads(frames[1][8:8 + hlen])
+    body = frames[1][8 + hlen:]
+    hdr["body"] = len(body) - 4
+    hb = _json.dumps(hdr).encode()
+    lying = b"LKVC" + _struct.pack("<I", len(hb)) + hb + body[:-4]
+    with pytest.raises(ValueError, match="leaf template implies"):
+        decode_stream([frames[0], lying])
+    # more blocks than the header declared (mid-stream overrun)
+    fat = encode_chunk(1, _fake_blocks(2))
+    with pytest.raises(ValueError, match="overruns"):
+        decode_stream([frames[0], frames[1], fat])
+    # any bytes after a complete stream are garbage too
+    with pytest.raises(ValueError, match="trailing"):
+        decode_stream(frames + [encode_chunk(2, _fake_blocks(1))])
+    # bytes after a complete stream
+    sp = FrameSplitter()
+    for f in frames:
+        sp.feed(f)
+    with pytest.raises(ValueError, match="trailing"):
+        sp.feed(b"LKVCmore")
+
+
+def test_stream_header_validates_coverage():
+    with pytest.raises(ValueError, match="cover"):
+        encode_stream_header(list(range(BLOCK + 1)), BLOCK, 2,
+                             [["k", "float32", [1, BLOCK, 2, 4]]])
+    with pytest.raises(ValueError, match="empty"):
+        encode_chunk(0, [])
+
+
+# -- prefix store: streamed export parity ------------------------------------
+
+
+def _np_groups(gen):
+    return [[[{n: np.asarray(v) for n, v in e.items()} for e in b]
+             for b in g] for g in gen]
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_export_stream_matches_export_blocks(tiny_server, paged):
+    """The incremental export yields bitwise the blocks the monolithic
+    export serves — cold walk and fully-present paths, dense and
+    paged."""
+    pool = mk_pool(tiny_server) if paged else None
+    store = PrefixStore(tiny_server, block=BLOCK, budget_mb=64,
+                        pool=pool)
+    rng = np.random.default_rng(3)
+    row = [int(t) for t in rng.integers(1, 300, size=4 * BLOCK + 3)]
+    head_s, gen = store.export_stream(row)
+    groups = _np_groups(gen)
+    stream_blocks = [b for g in groups for b in g]
+    out = store.export_blocks(row)
+    assert out is not None
+    head_m, mono_blocks = out
+    assert head_s == head_m
+    _assert_blocks_equal(mono_blocks, stream_blocks)
+    # second stream serves the now-present tree — still bitwise
+    head2, gen2 = store.export_stream(row)
+    again = [b for g in _np_groups(gen2) for b in g]
+    assert head2 == head_s
+    _assert_blocks_equal(stream_blocks, again)
+    if pool is not None:
+        pool.check_invariants()
+
+
+# -- prefix store: chunked import --------------------------------------------
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_import_stream_commit_and_idempotence(tiny_server, paged):
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=64)
+    rng = np.random.default_rng(4)
+    row = [int(t) for t in rng.integers(1, 300, size=3 * BLOCK + 1)]
+    head, gen = exp.export_stream(row)
+    groups = _np_groups(gen)
+    pool = mk_pool(tiny_server) if paged else None
+    imp_store = PrefixStore(tiny_server, block=BLOCK, budget_mb=64,
+                            pool=pool)
+    with tiny_server._prefix_lock:
+        tiny_server._prefixes.clear()
+    imp = imp_store.import_begin(head)
+    for g in groups:
+        imp.add_blocks(g)
+    res = imp.commit()
+    assert res["inserted"] == len(head) // BLOCK
+    assert res["mode"] == ("paged" if paged else "dense")
+    assert imp_store.present_len(row) == len(head)
+    # a second identical stream is wholly idempotent
+    imp2 = imp_store.import_begin(head)
+    for g in groups:
+        imp2.add_blocks(g)
+    res2 = imp2.commit()
+    assert res2 == {"present": len(head) // BLOCK, "inserted": 0,
+                    "mode": res["mode"]}
+    if pool is not None:
+        pool.check_invariants()
+        # the zero-copy consumer sees the shipped bytes bitwise
+        got = imp_store.acquire_pages(head)
+        assert got is not None and got[1] == len(head)
+        from lambdipy_tpu.models.llama import arena_page_slices
+
+        with pool.arena_lock:
+            arena = pool.ensure_arena()
+        flat = [b for g in groups for b in g]
+        for k, pid in enumerate(got[0]):
+            _assert_blocks_equal(
+                [flat[k]], [arena_page_slices(arena, pid, pool.page)])
+        pool.release(got[0])
+        pool.check_invariants()
+
+
+def test_import_stream_abort_releases_everything(tiny_server):
+    """Mid-stream abort: every staged page returns, the tree is
+    untouched, and pinned/staged accounting reads exactly zero — with
+    a live session pin on an unrelated prefix to prove the sweep
+    boundaries hold."""
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=64)
+    rng = np.random.default_rng(5)
+    row = [int(t) for t in rng.integers(1, 300, size=3 * BLOCK + 1)]
+    head, gen = exp.export_stream(row)
+    groups = _np_groups(gen)
+    pool = mk_pool(tiny_server)
+    store = PrefixStore(tiny_server, block=BLOCK, budget_mb=64,
+                        pool=pool)
+    # a pinned session on a DIFFERENT prefix must survive the abort
+    other = [int(t) for t in rng.integers(301, 500,
+                                          size=2 * BLOCK + 1)]
+    store.route(other)
+    pinned_tokens = store.pin_session("sess-leak", other)
+    assert pinned_tokens > 0
+    base = store.stats()
+    assert base["pinned_leaves"] > 0
+    imp = store.import_begin(head)
+    imp.add_blocks(groups[0])  # one chunk staged, stream dies here
+    imp.abort()
+    imp.abort()  # idempotent
+    pool.check_invariants()
+    assert store.present_len(row) == 0
+    after = store.stats()
+    assert after["pinned_leaves"] == base["pinned_leaves"]
+    assert after["pinned_bytes"] == base["pinned_bytes"]
+    # commit after abort is refused; a fresh truncated commit rolls back
+    with pytest.raises(ValueError, match="closed"):
+        imp.commit()
+    imp3 = store.import_begin(head)
+    imp3.add_blocks(groups[0])
+    with pytest.raises(ValueError, match="truncated"):
+        imp3.commit()
+    pool.check_invariants()
+    assert store.present_len(row) == 0
+    # close the session: accounting converges to exactly zero
+    store.end_session("sess-leak")
+    final = store.stats()
+    assert final["pinned_leaves"] == 0 and final["pinned_bytes"] == 0
+    pool.check_invariants()
+
+
+def test_import_stream_backpressure_reserves_up_front(tiny_server):
+    """A ship the arena cannot hold fails at import_begin — before any
+    wire time is spent — and leaks nothing."""
+    pool = mk_pool(tiny_server, n_windows=0, extra_pages=2)
+    store = PrefixStore(tiny_server, block=BLOCK, budget_mb=64,
+                        pool=pool)
+    rng = np.random.default_rng(6)
+    row = [int(t) for t in rng.integers(1, 300, size=4 * BLOCK)]
+    with pytest.raises(PagesExhausted):
+        store.import_begin(row[:3 * BLOCK])
+    pool.check_invariants()
+    st = pool.stats()
+    assert st["pages_live"] == 0
+
+
+def test_import_stream_rejects_bad_geometry(tiny_server):
+    store = PrefixStore(tiny_server, block=BLOCK, budget_mb=64)
+    rng = np.random.default_rng(7)
+    with pytest.raises(ValueError, match="cover"):
+        store.import_begin([1, 2, 3])  # not whole blocks
+    cfg = tiny_server.model.cfg
+    too_long = [int(t) for t in rng.integers(1, 300, size=cfg.max_len)]
+    with pytest.raises(ValueError, match="no room"):
+        store.import_begin(too_long)
+    # a chunk whose layout lies is rejected at add time, pre-commit
+    head = [int(t) for t in rng.integers(1, 300, size=2 * BLOCK)]
+    exp = PrefixStore(tiny_server, block=BLOCK, budget_mb=64)
+    _, gen = exp.export_stream(head + [5])
+    groups = _np_groups(gen)
+    imp = store.import_begin(head)
+    bad = [[{**entry} for entry in groups[0][0]]]
+    bad[0][0].pop(sorted(bad[0][0])[0])
+    with pytest.raises(ValueError, match="store layout"):
+        imp.add_blocks(bad)
+    imp.abort()
+    # overrun past the declared head
+    imp2 = store.import_begin(head)
+    for g in groups:
+        imp2.add_blocks(g)
+    with pytest.raises(ValueError, match="overruns"):
+        imp2.add_blocks(groups[0])
+    imp2.abort()
+
+
+# -- handler-level stream surface --------------------------------------------
+
+
+def test_handler_stream_export_import_roundtrip(tiny_server):
+    """The handlers' kv_export_stream/kv_import_stream functions wire
+    the store to the LKVS/LKVC frames bitwise, and their stats move."""
+    import json
+
+    from lambdipy_tpu.runtime import handlers as handlers_mod
+
+    # build the closures the real handler factory builds, against two
+    # independent stores (exporter / importer) over the shared server
+    rng = np.random.default_rng(8)
+    row = [int(t) for t in rng.integers(1, 300, size=3 * BLOCK + 2)]
+
+    def mk(store, stats):
+        from lambdipy_tpu.runtime.kvwire import (
+            StreamDecoder as SD,
+            encode_chunk as ec,
+            encode_stream_header as esh,
+        )
+
+        def export_stream(req):
+            out = store.export_stream(list(req["tokens"]))
+            head, groups = out
+            cfg = store.server.model.cfg
+            leaves = [[name, dt.name, list(shape)]
+                      for name, (shape, dt)
+                      in sorted(store._leaf_template().items())]
+
+            def gen():
+                yield esh(head, store.block, cfg.layers, leaves)
+                sent = 0
+                for group in groups:
+                    yield ec(sent, group)
+                    sent += len(group)
+
+            return gen()
+
+        def import_stream(chunks):
+            dec = SD()
+            imp = None
+            try:
+                for data in chunks:
+                    for kind, payload in dec.feed(data):
+                        if kind == "header":
+                            imp = store.import_begin(payload["tokens"])
+                        else:
+                            imp.add_blocks(payload[1])
+                if imp is None or not dec.complete:
+                    raise ValueError("truncated KV stream")
+                return imp.commit()
+            except BaseException:
+                if imp is not None:
+                    imp.abort()
+                raise
+
+        return export_stream, import_stream
+
+    exp_store = PrefixStore(tiny_server, block=BLOCK, budget_mb=64)
+    imp_store = PrefixStore(tiny_server, block=BLOCK, budget_mb=64)
+    export_stream, _ = mk(exp_store, None)
+    _, import_stream = mk(imp_store, None)
+    frames = list(export_stream({"tokens": row}))
+    assert len(frames) >= 2
+    with tiny_server._prefix_lock:
+        tiny_server._prefixes.clear()
+    res = import_stream(iter(frames))
+    head = row[:(len(row) - 1) // BLOCK * BLOCK]
+    assert res["inserted"] == len(head) // BLOCK
+    assert imp_store.present_len(row) == len(head)
+    # and the real handler module exposes the hook names the server
+    # routes to (wiring regression)
+    assert hasattr(handlers_mod.HandlerState, "kv_export_stream_fn")
+    assert hasattr(handlers_mod.HandlerState, "kv_import_stream_fn")
+    del json

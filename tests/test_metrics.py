@@ -496,3 +496,69 @@ def test_page_pool_merges_pinned_gauges():
 
     pool.pinned_fn = broken
     assert "pages_total" in pool.stats()  # still serves
+
+
+def test_kv_ship_stats_stream_counters():
+    from lambdipy_tpu.runtime.metrics import KvShipStats
+
+    st = KvShipStats()
+    rep = st.report()
+    assert rep["export_streams"] == rep["import_streams"] == 0
+    assert rep["import_stream_aborts"] == 0
+    # a monolithic export/import never bumps the stream counters
+    st.record_export(tokens=32, nbytes=1000)
+    st.record_import(tokens=32, nbytes=1000, inserted=2, present=0,
+                     mode="dense")
+    rep = st.report()
+    assert rep["export_streams"] == 0 and rep["import_streams"] == 0
+    # chunked ones do, and aborts are their own row
+    st.record_export(tokens=64, nbytes=2000, chunks=4)
+    st.record_import(tokens=64, nbytes=2000, inserted=4, present=0,
+                     mode="paged", chunks=4)
+    st.record_stream_abort()
+    rep = st.report()
+    assert rep["exports"] == 2 and rep["export_streams"] == 1
+    assert rep["export_chunks"] == 4
+    assert rep["imports"] == 2 and rep["import_streams"] == 1
+    assert rep["import_chunks"] == 4
+    assert rep["import_stream_aborts"] == 1
+
+
+def test_disagg_stats_pipelined_and_util():
+    from lambdipy_tpu.runtime.metrics import DisaggStats
+
+    st = DisaggStats()
+    rep = st.report()
+    assert rep["ships_pipelined"] == 0 and rep["chunks_relayed"] == 0
+    assert rep["mid_stream_failures"] == 0 and rep["util"] == {}
+    st.record_ship(nbytes=1000, ms=10.0)            # monolithic
+    st.record_ship(nbytes=2000, ms=20.0, chunks=4)  # chunked, BLOCKING
+    st.record_ship(nbytes=3000, ms=30.0, chunks=5,
+                   pipelined=True)                  # chunked, pipelined
+    st.count("mid_stream_failures")
+    rep = st.report()
+    # pipelined is an explicit flag: the buffer-then-relay baseline
+    # ships chunk frames too but must not count as overlapped
+    assert rep["ships"] == 3 and rep["ships_pipelined"] == 1
+    assert rep["chunks_relayed"] == 9
+    assert rep["mid_stream_failures"] == 1
+    # util EWMA: first sample seeds, later samples smooth (alpha .3),
+    # and out-of-range samples clamp
+    st.record_util("prefill", 0.5)
+    assert st.report()["util"] == {"prefill": 0.5}
+    st.record_util("prefill", 1.0)
+    assert abs(st.report()["util"]["prefill"] - 0.65) < 1e-9
+    st.record_util("decode", 7.0)   # clamps to 1.0
+    st.record_util("mixed", -1.0)   # clamps to 0.0
+    util = st.report()["util"]
+    assert util["decode"] == 1.0 and util["mixed"] == 0.0
+
+
+def test_session_stats_drain_reships():
+    from lambdipy_tpu.runtime.metrics import SessionStats
+
+    st = SessionStats()
+    assert st.report()["drain_reships"] == 0
+    st.count("drain_reships", 2)
+    rep = st.report()
+    assert rep["drain_reships"] == 2 and rep["reships"] == 0
