@@ -3168,6 +3168,42 @@ def _chaos_fleet_main() -> int:
     return 0
 
 
+def _soak_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--soak", action="store_true")
+    ap.add_argument("--seed", type=int, action="append", default=None,
+                    help="soak seed (repeatable); default: the fixed "
+                         "CI set (11, 23) plus a determinism re-run")
+    ap.add_argument("--soak-seconds", type=float, default=None,
+                    help="window length per seed (default 22 s; longer "
+                         "randomized runs use this with --seed)")
+    ap.add_argument("--replay-timeline", type=str, default=None,
+                    help="timeline file from a failing run: replay its "
+                         "exact schedule under --seed's workload")
+    ap.add_argument("--no-determinism", action="store_true")
+    args = ap.parse_args()
+    _enable_compile_cache()
+    from lambdipy_tpu.chaos.soak import soak_record
+
+    replay = None
+    if args.replay_timeline:
+        with open(args.replay_timeline) as f:
+            replay = f.read()
+    seeds = tuple(args.seed) if args.seed else (11, 23)
+    kwargs = {}
+    if args.soak_seconds:
+        kwargs["duration_s"] = float(args.soak_seconds)
+    # the determinism re-run is the CI default; explicit seeds/replays
+    # are operator iteration loops and skip it
+    determinism = (not args.no_determinism and args.seed is None
+                   and replay is None)
+    print(json.dumps(soak_record(seeds=seeds, replay_timeline=replay,
+                                 determinism=determinism, **kwargs)))
+    return 0
+
+
 def _chaos_main() -> int:
     import argparse
 
@@ -3467,6 +3503,18 @@ def main() -> int:
         # turn-2+ TTFT <= 0.15x cold on a healthy home, and pin
         # accounting returning to exactly zero after sessions close
         return _sessions_main()
+    if "--soak" in sys.argv:
+        # CPU-runnable composed-fault chaos soak (managed subprocess
+        # replicas behind the resilient sticky-session router): a
+        # seeded nemesis arms overlapping fault-site events, SIGKILLs a
+        # worker, and drains a replica while a seeded open-loop mixed
+        # workload runs; the history checker asserts zero silent losses
+        # (delivered => bitwise vs the direct reference; failed =>
+        # explicit priced shed), bounded waiters, and quiesce
+        # convergence (invariant sweeps pass, pins/spill -> 0). Exits
+        # nonzero on any violation, printing the seed + timeline for
+        # one-command replay.
+        return _soak_main()
     if "--chaos-fleet" in sys.argv:
         # CPU-runnable fleet-boundary chaos matrix: router-side network
         # faults (drop/latency/mid-body/flap) + a fleet-wide shed burst

@@ -433,6 +433,18 @@ class ReplicaPool:
             except Exception:  # noqa: BLE001 — re-ship is advisory
                 log_event(log, "on_drain hook failed", name=name)
 
+    def end_drain(self, name: str) -> None:
+        """Abort a drain begun with :meth:`begin_drain`: the replica
+        returns to routing without a restart. ``rolling_restart`` never
+        needs this (its drain always ends in a redeploy); the chaos
+        nemesis's drain/undrain events — and an operator changing their
+        mind — do. A replica that meanwhile ejected or stopped is left
+        alone: only DRAINING flips back."""
+        with self._lock:
+            r = self.replicas[name]
+            if r.state == DRAINING:
+                r.state = READY
+
     # -- lifecycle ----------------------------------------------------------
 
     def rolling_restart(self, *, live_floor: int = 1,

@@ -155,6 +155,7 @@ class FleetRouter:
                  retry_budget: float = 0.0, retry_budget_min: int = 3,
                  warm_prefixes: int = 4,
                  ship_window: int = 4, ship_pipelined: bool = True,
+                 session_record_ttl_s: float = 3600.0,
                  faults: FaultPlan | None = None):
         self.pool = pool
         self.affinity_on = bool(affinity_on)
@@ -214,14 +215,20 @@ class FleetRouter:
         # from the pool's time-weighted occupancy at scrape time
         self._util_lock = threading.Lock()
         self._util_prev = {"t": time.monotonic(), "busy": {}}
-        # sticky multi-turn sessions: sid -> {home, head, key}, LRU-
+        # sticky multi-turn sessions: sid -> {home, head, key, t}, LRU-
         # bounded (losing a record only loses stickiness — the next turn
         # re-places by prefix affinity, which is where the KV lives
         # anyway). `head` is the conversation's whole-block token head,
-        # what a failover re-ship exports from the old home.
+        # what a failover re-ship exports from the old home. Records
+        # idle past session_record_ttl_s are swept LAZILY (found by the
+        # chaos soak's quiesce probe: replica-side pin LEASES expire,
+        # but a router record only ever died by cap pressure or DELETE,
+        # so a long-lived router's session gauge drifted arbitrarily
+        # far from the fleet's real pinned state).
         self.sessions = SessionStats()
         self._session_map: OrderedDict = OrderedDict()
         self._session_cap = 4096
+        self.session_record_ttl_s = max(1.0, float(session_record_ttl_s))
         self._session_lock = threading.Lock()
         # on_admit is always hooked: it clears the shipped-key cache
         # for a readmitted replica, then (when enabled) cache-warms it
@@ -553,6 +560,30 @@ class FleetRouter:
         return {r.name: r for r in self.pool.routable()
                 if r.role != PREFILL and not self._breaker_blocked(r)}
 
+    def _sweep_session_records_locked(self, now: float) -> None:
+        """Lazily drop sticky records idle past ``session_record_ttl_s``
+        (LRU order — the front of the map is the longest-idle record).
+        The replica-side pin LEASES expired long ago for these; keeping
+        the record only misreports ``fleet.sessions.active`` and makes
+        a post-idle turn chase a home whose pins are gone anyway (a
+        prefix-affinity re-place serves it identically)."""
+        ttl = self.session_record_ttl_s
+        while self._session_map:
+            _, rec = next(iter(self._session_map.items()))
+            if now - rec.get("t", now) <= ttl:
+                break
+            self._session_map.popitem(last=False)
+            self.sessions.count("record_expiries")
+
+    def _live_session_count(self) -> int:
+        """Session gauge for /metrics, /healthz and the invariant
+        sweep: runs the lazy TTL sweep first, so a scrape alone
+        converges the router's view like the replica's own lease
+        expiry does."""
+        with self._session_lock:
+            self._sweep_session_records_locked(time.monotonic())
+            return len(self._session_map)
+
     def _session_sticky(self, sid: str, body: dict) -> str | None:
         """Resolve the session's home replica for this turn: the
         recorded home when it is still routable (sticky hit), a freshly
@@ -577,6 +608,7 @@ class FleetRouter:
             if sid not in self._session_map:
                 return None
             self._session_map.move_to_end(sid)
+            rec["t"] = time.monotonic()
             # each turn's prompt extends the conversation: keep the
             # LONGEST head seen — that is what a failover re-ships
             if head is not None and (rec["head"] is None
@@ -728,6 +760,7 @@ class FleetRouter:
                 # turn's head into the record — only the home (and the
                 # key) need refreshing, no second O(history) extraction
                 rec["home"] = replica_name
+                rec["t"] = time.monotonic()
                 if key is not None:
                     rec["key"] = key
                 self._session_map.move_to_end(sid)
@@ -736,15 +769,19 @@ class FleetRouter:
             body, block=self.block,
             key_blocks=affinity.SESSION_KEY_BLOCKS)
         with self._session_lock:
+            now = time.monotonic()
+            self._sweep_session_records_locked(now)
             rec = self._session_map.get(sid)
             if rec is None:
                 self._session_map[sid] = {"home": replica_name,
-                                          "head": head, "key": key}
+                                          "head": head, "key": key,
+                                          "t": now}
                 self.sessions.count("opened")
                 while len(self._session_map) > self._session_cap:
                     self._session_map.popitem(last=False)
             else:  # a racer created it between the two locked sections
                 rec["home"] = replica_name
+                rec["t"] = now
                 if key is not None:
                     rec["key"] = key
                 if head is not None and (rec["head"] is None
@@ -1781,9 +1818,13 @@ class FleetRouter:
                                    "reasons": sd_reasons},
                 # sticky multi-turn sessions: open records + sticky/
                 # failover/re-ship counters
+                # gauge FIRST: the live count runs the lazy TTL sweep,
+                # and the counters snapshot must include any expiries
+                # that sweep just recorded (same-scrape convergence,
+                # like the replica's lease expiry on stats())
                 "sessions": {
+                    "active": self._live_session_count(),
                     **self.sessions.report(),
-                    "active": len(self._session_map),
                 },
                 # phase-split serving: router-side dispatch/ship/EWMA
                 # counters (incl. per-class busy-fraction EWMAs under
@@ -1796,7 +1837,62 @@ class FleetRouter:
                     "replicas": ship_agg,
                 },
             },
+            # faults.armed: the ROUTER process's live injection plan
+            # (route_*/probe/kv_ship* sites) — a soak run or a stray
+            # LAMBDIPY_FLEET_FAULT is visible at the front door. The
+            # pool usually shares this plan; a distinct pool plan (probe
+            # site armed separately) reports alongside.
+            "faults": {
+                "armed": self.faults.armed(),
+                **({"pool_armed": self.pool.faults.armed()}
+                   if self.pool.faults is not self.faults else {}),
+            },
             "replicas": per_replica,
+        }
+
+    def debug_invariants(self) -> dict:
+        """Host-only fleet invariant sweep (GET /v1/debug/invariants):
+        fans out to every replica's own sweep concurrently and folds the
+        verdicts. ``ok`` covers the replicas that ANSWERED and are
+        routable — an ejected replica's accounting died with it (the
+        sessions bench's "died with its pins" rule); the router-side
+        gauges (spill depth, open sessions) ride along for the chaos
+        checker's quiesce assertions."""
+        results: dict = {}
+
+        def probe(name: str, url: str) -> None:
+            try:
+                results[name] = _http_json(
+                    f"{url}/v1/debug/invariants",
+                    timeout=self.pool.probe_timeout)
+            except Exception as e:  # noqa: BLE001 — dead replica
+                results[name] = {"unreachable": True, "error": str(e)}
+
+        threads = [threading.Thread(target=probe, args=(n, r.url),
+                                    daemon=True)
+                   for n, r in self.pool.replicas.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.pool.probe_timeout + 2.0)
+        ok = True
+        for name, r in self.pool.replicas.items():
+            rep = results.get(name)
+            if not r.routable:
+                # an ejected/draining replica's accounting died (or is
+                # dying) with it: reported for the operator, never
+                # folded into the fleet verdict
+                continue
+            if rep is None or rep.get("unreachable"):
+                ok = False  # routable but not answering the sweep
+                continue
+            ok = ok and bool(rep.get("ok"))
+        return {
+            "ok": ok,
+            "replicas": results,
+            "spill_depth": (self.spill.depth()
+                            if self.spill is not None else 0),
+            "sessions": self._live_session_count(),
         }
 
     def _class_counts(self) -> dict:
@@ -1884,12 +1980,22 @@ class FleetRouter:
                         **({"wedged": wedged} if wedged else {}),
                         **({"spill_depth": router_self.spill.depth()}
                            if router_self.spill is not None else {}),
-                        "sessions": len(router_self._session_map),
+                        "sessions": router_self._live_session_count(),
                         "affinity": router_self.affinity_on,
                         "block": router_self.block,
                     })
                 elif self.path == "/metrics":
                     self.send(200, router_self.metrics())
+                elif self.path == "/v1/debug/invariants":
+                    # host-only, like the replica twin: a fault-surface
+                    # and cache-internals sweep is operator tooling
+                    if self.client_address[0] not in ("127.0.0.1",
+                                                      "::1"):
+                        self.send(403, {"ok": False, "error":
+                                        "host-only endpoint (loopback "
+                                        "clients only)"})
+                        return
+                    self.send(200, router_self.debug_invariants())
                 else:
                     self.send(404, {"ok": False, "error": "not found"})
 

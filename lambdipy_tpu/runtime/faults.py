@@ -69,6 +69,16 @@ the 1-based per-site call index where the rule starts firing (default 1),
 unlimited for hang; ``n=inf`` forces unlimited), ``ms=X`` the delay
 duration. Call counting is per site and strictly deterministic — the
 whole point is that a chaos case replays identically run after run.
+
+Sites live in a structured ``REGISTRY`` (:class:`FaultSite`: owning
+layer, arming env var, semantics note) that feeds the chaos soak's
+nemesis menu (:func:`list_sites`) and the docs table; a grep-based test
+asserts every ``check()`` call site in the tree is registered. Plans
+also support RUNTIME arming (:meth:`FaultPlan.arm` /
+:meth:`FaultPlan.clear` / :meth:`FaultPlan.armed` — the replica's
+``POST /v1/debug/faults`` control surface and the ``faults.armed``
+metrics block), so composed faults can start and stop on a nemesis
+timeline without restarting the process.
 """
 
 from __future__ import annotations
@@ -79,12 +89,80 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-SITES = ("segment_dispatch", "segment_fetch", "group_prefill",
-         "prefix_assemble", "prefix_walk", "transport", "page_alloc",
-         # fleet-layer (router/pool) network sites
-         "route_connect", "route_body", "route_latency", "probe",
-         "kv_ship", "kv_ship_chunk", "session_pin", "session_failover")
+@dataclass(frozen=True)
+class FaultSite:
+    """One registered injection point. ``owner`` names the layer whose
+    plan drives it (engine | store | pool | router), ``env`` the spec
+    env var that arms it in a live process (engine/store sites ride the
+    replica's ``LAMBDIPY_FAULT``; pool/router sites the fleet process's
+    ``LAMBDIPY_FLEET_FAULT``), ``note`` a one-line semantics summary.
+    The chaos soak's nemesis menu and the docs table are both derived
+    from this registry — and a grep-based test asserts every
+    ``faults.check(...)``/``_device_wait(...)`` call site in the tree is
+    registered here, so a new site cannot silently dodge the soak."""
+
+    name: str
+    owner: str
+    env: str
+    note: str
+
+
+_ENGINE_ENV = "LAMBDIPY_FAULT"
+_FLEET_ENV = "LAMBDIPY_FLEET_FAULT"
+
+REGISTRY: dict[str, FaultSite] = {s.name: s for s in (
+    FaultSite("segment_dispatch", "engine", _ENGINE_ENV,
+              "the engine thread dispatching a decode segment"),
+    FaultSite("segment_fetch", "engine", _ENGINE_ENV,
+              "the per-segment device_get in the collector"),
+    FaultSite("group_prefill", "engine", _ENGINE_ENV,
+              "the engine's ragged b-row joiner prefill"),
+    FaultSite("prefix_assemble", "engine", _ENGINE_ENV,
+              "continue-prefill from a cached prefix KV"),
+    FaultSite("prefix_walk", "store", _ENGINE_ENV,
+              "the prefix store's cold walk, once per chunk dispatch "
+              "(exception fails the walk open; delay models per-chunk "
+              "prefill device time)"),
+    FaultSite("transport", "engine", _ENGINE_ENV,
+              "the block_until_ready device wait before fetch"),
+    FaultSite("page_alloc", "store", _ENGINE_ENV,
+              "the paged-KV pool taking pages for an admission"),
+    FaultSite("session_pin", "store", _ENGINE_ENV,
+              "the prefix store pinning a session's radix head (fails "
+              "OPEN: the turn serves unpinned, counted)"),
+    # fleet-layer (router/pool) network sites
+    FaultSite("route_connect", "router", _FLEET_ENV,
+              "the fleet router opening a replica connection"),
+    FaultSite("route_body", "router", _FLEET_ENV,
+              "the router reading a replica response body"),
+    FaultSite("route_latency", "router", _FLEET_ENV,
+              "the router's forward path (network latency site)"),
+    FaultSite("probe", "pool", _FLEET_ENV,
+              "the replica pool's per-replica health probe"),
+    FaultSite("kv_ship", "router", _FLEET_ENV,
+              "the router's prefill->decode KV ship, once per attempt"),
+    FaultSite("kv_ship_chunk", "router", _FLEET_ENV,
+              "the pipelined ship relay, once per relayed KV chunk "
+              "frame (exception = mid-stream transfer failure; delay = "
+              "per-chunk synthetic wire time)"),
+    FaultSite("session_failover", "router", _FLEET_ENV,
+              "the router re-homing a session off a dead/drained "
+              "replica (exception skips the re-ship, counted)"),
+)}
+
+# tuple view kept for spec validation, matrix iteration (bench.py
+# --chaos walks it) and backward compatibility with pre-registry callers
+SITES = tuple(REGISTRY)
 KINDS = ("exception", "delay", "hang")
+
+
+def list_sites(*, owner: str | None = None,
+               env: str | None = None) -> list[FaultSite]:
+    """Registry query feeding the nemesis menu and the docs table:
+    all sites, optionally filtered by owning layer or arming env var."""
+    return [s for s in REGISTRY.values()
+            if (owner is None or s.owner == owner)
+            and (env is None or s.env == env)]
 _KIND_ALIASES = {"error": "exception", "raise": "exception",
                  "sleep": "delay", "stall": "delay", "block": "hang"}
 
@@ -137,10 +215,54 @@ class FaultRule:
                 + (f",ms={self.ms:g}" if self.kind == "delay" else ""))
 
 
+def parse_spec(spec: str | None) -> list[FaultRule]:
+    """Parse a fault spec string into rules (shared by
+    :meth:`FaultPlan.from_spec` and the runtime :meth:`FaultPlan.arm`)."""
+    rules: list[FaultRule] = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, params = part.partition("@")
+        site, sep, kind = head.partition(":")
+        site, kind = site.strip(), kind.strip().lower()
+        kind = _KIND_ALIASES.get(kind, kind)
+        if not sep or site not in SITES or kind not in KINDS:
+            raise ValueError(
+                f"bad fault spec {part!r}: want site:kind with site in "
+                f"{SITES} and kind in {KINDS}")
+        rule = FaultRule(site=site, kind=kind,
+                         n=(math.inf if kind == "hang" else 1))
+        for kv in filter(None, (p.strip() for p in params.split(","))):
+            key, eq, val = kv.partition("=")
+            key = key.strip().lower()
+            try:
+                if key in ("seg", "at"):
+                    rule.seg = max(1, int(val))
+                elif key == "n":
+                    rule.n = math.inf if val.strip() in ("inf", "-1") \
+                        else max(1, int(val))
+                elif key == "ms":
+                    rule.ms = max(0.0, float(val))
+                else:
+                    raise ValueError(key)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault param {kv!r} in {part!r} "
+                    f"(known: seg=N, n=K|inf, ms=X)") from None
+        rules.append(rule)
+    return rules
+
+
 class FaultPlan:
     """A deterministic set of :class:`FaultRule`\\ s plus the per-site
     call counters they key on. An empty plan is a no-op and costs one
-    ``if`` per site check — safe to leave wired in production."""
+    ``if`` per site check — safe to leave wired in production.
+
+    Rules may also be armed and cleared AT RUNTIME (:meth:`arm` /
+    :meth:`clear`) — the chaos soak's nemesis drives a live replica's
+    plan over ``POST /v1/debug/faults`` this way, and a cleared plan
+    releases its in-flight hangs without poisoning later ones."""
 
     def __init__(self, rules: list[FaultRule] | None = None):
         self.rules = list(rules or ())
@@ -159,40 +281,7 @@ class FaultPlan:
         """Parse ``site:kind@k=v,...;site2:...``; unknown sites/kinds and
         malformed params raise ``ValueError`` — a typo in a chaos spec
         must fail the run loudly, not silently test nothing."""
-        rules: list[FaultRule] = []
-        for part in (spec or "").split(";"):
-            part = part.strip()
-            if not part:
-                continue
-            head, _, params = part.partition("@")
-            site, sep, kind = head.partition(":")
-            site, kind = site.strip(), kind.strip().lower()
-            kind = _KIND_ALIASES.get(kind, kind)
-            if not sep or site not in SITES or kind not in KINDS:
-                raise ValueError(
-                    f"bad fault spec {part!r}: want site:kind with site in "
-                    f"{SITES} and kind in {KINDS}")
-            rule = FaultRule(site=site, kind=kind,
-                             n=(math.inf if kind == "hang" else 1))
-            for kv in filter(None, (p.strip() for p in params.split(","))):
-                key, eq, val = kv.partition("=")
-                key = key.strip().lower()
-                try:
-                    if key in ("seg", "at"):
-                        rule.seg = max(1, int(val))
-                    elif key == "n":
-                        rule.n = math.inf if val.strip() in ("inf", "-1") \
-                            else max(1, int(val))
-                    elif key == "ms":
-                        rule.ms = max(0.0, float(val))
-                    else:
-                        raise ValueError(key)
-                except ValueError:
-                    raise ValueError(
-                        f"bad fault param {kv!r} in {part!r} "
-                        f"(known: seg=N, n=K|inf, ms=X)") from None
-            rules.append(rule)
-        return cls(rules)
+        return cls(parse_spec(spec))
 
     @classmethod
     def from_env(cls, environ=None, *, var: str = "LAMBDIPY_FAULT"
@@ -227,13 +316,66 @@ class FaultPlan:
             time.sleep(rule.ms / 1e3)
             return
         if rule.kind == "hang":
+            # capture the CURRENT release event: clear() sets it and then
+            # installs a fresh one, so this hang resolves while a
+            # later-armed hang still blocks (runtime re-arming must not
+            # inherit a permanently-released plan)
+            release = self._release
             deadline = time.monotonic() + HANG_CAP_S
             while time.monotonic() < deadline:
-                if self._release.wait(0.02):
+                if release.wait(0.02):
                     break
                 if interrupt is not None and interrupt.is_set():
                     break
         raise InjectedFault(site, rule.kind, count)
+
+    # -- runtime arming (nemesis control surface) ----------------------------
+
+    def arm(self, spec: str) -> list[str]:
+        """Parse ``spec`` and ADD its rules to the live plan (call
+        counters keep running — a rule armed mid-soak fires on the next
+        matching call). Returns the added rules' descriptions; raises
+        ``ValueError`` on a bad spec, touching nothing."""
+        rules = parse_spec(spec)
+        with self._lock:
+            self.rules.extend(rules)
+        return [r.describe() for r in rules]
+
+    def clear(self) -> int:
+        """Drop every rule and release in-flight hangs, leaving the plan
+        re-armable: waiters blocked on the old release event resolve
+        (raising ``InjectedFault``, as an abandoned wait must), while
+        hangs armed LATER block on the fresh event. Call counters are
+        kept — they are the deterministic spine replay depends on.
+        Returns the number of rules cleared."""
+        with self._lock:
+            n = len(self.rules)
+            self.rules = []
+            released, self._release = self._release, threading.Event()
+        released.set()
+        return n
+
+    def armed(self) -> dict:
+        """Live-plan snapshot for ``/metrics`` (``faults.armed``): the
+        armed sites/kinds with remaining fire counts, plus the per-site
+        call counters — so a soak run (or a stray ``LAMBDIPY_FAULT``
+        left set in prod) is visible at the front door."""
+        with self._lock:
+            rules = [{
+                "site": r.site,
+                "kind": r.kind,
+                "seg": r.seg,
+                "n": ("inf" if math.isinf(r.n) else int(r.n)),
+                **({"ms": r.ms} if r.kind == "delay" else {}),
+                "fired": r.fired,
+                "remaining": ("inf" if math.isinf(r.n)
+                              else max(0, int(r.n) - r.fired)),
+            } for r in self.rules]
+            counts = dict(self._counts)
+        return {"active": bool(rules),
+                "sites": sorted({r["site"] for r in rules}),
+                "rules": rules,
+                "counts": counts}
 
     # -- lifecycle / introspection -------------------------------------------
 

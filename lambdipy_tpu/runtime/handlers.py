@@ -73,6 +73,16 @@ class HandlerState:
     # optional session close (DELETE /v1/sessions/{id} -> release the
     # session's prefix-store pins now instead of waiting out the lease)
     session_end_fn: Callable[[str], dict] | None = None
+    # optional host-only invariant sweep (GET /v1/debug/invariants):
+    # pagepool conservation + prefix-store pin/content accounting as
+    # {"ok", "checks"} — the chaos checker's quiesce probe. Cheap and
+    # lock-bounded; never device work.
+    debug_invariants_fn: Callable[[], dict] | None = None
+    # optional host-only fault control (POST /v1/debug/faults): arm a
+    # runtime/faults.py spec on the replica's live plan or clear it —
+    # the chaos soak's nemesis arms composed faults on a timeline
+    # through this instead of restarting the process per spec.
+    faults_admin_fn: Callable[[dict], dict] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
@@ -841,6 +851,65 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 mode=res["mode"], chunks=chunks)
             return {"ok": True, **res, "streamed": True}
 
+    # -- chaos/debug surfaces (runtime/faults.py + the invariant sweep) ------
+    # ONE live fault plan serves the whole replica (engine sites, the
+    # store's prefix_walk/session_pin, the pool's page_alloc): the
+    # continuous engine always owns a plan (empty when nothing is
+    # armed), so the soak's nemesis can arm/clear it at runtime over
+    # POST /v1/debug/faults and /metrics can report what is armed.
+    live_faults = None
+    if continuous is not None:
+        live_faults = continuous.faults
+    elif prefix_store is not None:
+        live_faults = prefix_store.faults
+
+    def debug_invariants() -> dict:
+        """Cheap host-side invariant sweep (GET /v1/debug/invariants —
+        the chaos checker's quiesce probe, also a live debugging aid):
+        page-pool conservation, prefix-store pin/content accounting,
+        plus the engine fault state as context. ``ok`` covers the
+        ACCOUNTING checks; transient serving state (wedged, degrade
+        level) is reported but judged by /healthz, not here."""
+        ok, checks = True, {}
+        if continuous is not None and continuous.pool is not None:
+            try:
+                continuous.pool.check_invariants()
+                checks["page_pool"] = {"ok": True}
+            except AssertionError as e:
+                checks["page_pool"] = {"ok": False, "error": str(e)}
+            checks["page_pool"]["stats"] = continuous.pool.stats()
+            ok = ok and checks["page_pool"]["ok"]
+        if prefix_store is not None:
+            checks["prefix_store"] = prefix_store.check_invariants()
+            ok = ok and checks["prefix_store"]["ok"]
+        if continuous is not None:
+            checks["engine"] = continuous.fault_state()
+        return {"ok": ok, "checks": checks}
+
+    def faults_admin(req: dict) -> dict:
+        """POST /v1/debug/faults (host-only): arm a fault spec on the
+        live plan or clear it — the chaos soak's nemesis control
+        surface, so composed faults can start and stop on a timeline
+        without restarting the replica."""
+        if live_faults is None:
+            return {"ok": False,
+                    "error": "no fault plan on this handler (neither a "
+                             "continuous engine nor a prefix store)"}
+        if req.get("clear"):
+            return {"ok": True, "cleared": live_faults.clear(),
+                    "armed": live_faults.armed()}
+        spec = req.get("spec")
+        if not spec:
+            return {"ok": False,
+                    "error": "want {\"spec\": \"site:kind@...\"} or "
+                             "{\"clear\": true}"}
+        try:
+            added = live_faults.arm(str(spec))
+        except ValueError as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": True, "added": added,
+                "armed": live_faults.armed()}
+
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a
     # multi-second compile at request time (measured ~14 s for a
@@ -1362,6 +1431,12 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # rides the prefix store, not the engine)
             out.setdefault("batching", {})["disagg"] = \
                 kv_ship_stats.report()
+        if live_faults is not None:
+            # faults.armed: the LIVE injection plan (sites, kinds,
+            # remaining fire counts) — a soak run, or a stray
+            # LAMBDIPY_FAULT left set in prod, is visible at the front
+            # door instead of only in the process's environment
+            out["faults"] = {"armed": live_faults.armed()}
         if warm_state["requested"] or warm_group:
             # gate on what was ASKED (listed buckets or the engine's
             # group-prefill warm), not on what finished: an in-flight
@@ -1392,6 +1467,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         kv_probe_fn=kv_probe,
         session_end_fn=(prefix_store.end_session
                         if prefix_store is not None else None),
+        debug_invariants_fn=debug_invariants,
+        faults_admin_fn=faults_admin,
         meta={
             "model": spec["model"], "quant": spec.get("quant"),
             "sharded": mesh is not None,

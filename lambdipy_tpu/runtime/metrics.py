@@ -763,7 +763,11 @@ class SessionStats:
     rendezvous successor BEFORE the next turn arrives, so the turn
     after a rolling restart pays a sticky hit, not a failover
     re-prefill); their failures land in ``reship_fallbacks`` like
-    turn-time ones."""
+    turn-time ones. ``record_expiries`` counts sticky records swept by
+    the router's idle TTL — replica-side pin leases expire on their
+    own, and without the sweep the router's session gauge drifted
+    arbitrarily far from the fleet's real pinned state (a chaos-soak
+    find)."""
 
     opened: int = 0
     sticky_hits: int = 0
@@ -772,6 +776,7 @@ class SessionStats:
     reships: int = 0
     drain_reships: int = 0
     deletes: int = 0
+    record_expiries: int = 0
     reship_fallbacks: dict = field(default_factory=dict)  # reason -> n
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
@@ -794,6 +799,7 @@ class SessionStats:
                 "reships": self.reships,
                 "drain_reships": self.drain_reships,
                 "deletes": self.deletes,
+                "record_expiries": self.record_expiries,
                 "reship_fallbacks": dict(self.reship_fallbacks),
             }
 

@@ -1335,6 +1335,71 @@ class PrefixStore:
 
     # -- observability -------------------------------------------------------
 
+    def check_invariants(self) -> dict:
+        """Cheap host-only accounting sweep — the replica's
+        ``/v1/debug/invariants`` surface and the chaos checker's quiesce
+        probe. Recomputes pin and content accounting from the tree and
+        cross-checks the live counters; paged mode additionally checks
+        every cached node's page is still live in the pool (the store
+        owns one ref per node). Returns ``{"ok", "violations", ...}``
+        with gauges — never raises, so it is safe to poll mid-traffic."""
+        violations: list[str] = []
+        with self._lock:
+            self._maybe_flush_stale_locked()
+            self._expire_sessions_locked(time.monotonic())
+            nodes = list(self._iter_nodes())
+            pinned = [n for n in nodes if n.pins > 0]
+            leaves, nbytes = len(pinned), sum(n.nbytes for n in pinned)
+            if leaves != self._pinned_leaves:
+                violations.append(
+                    f"pinned_leaves counter {self._pinned_leaves} != "
+                    f"{leaves} pinned nodes in the tree")
+            if nbytes != self._pinned_bytes:
+                violations.append(
+                    f"pinned_bytes counter {self._pinned_bytes} != "
+                    f"{nbytes} recomputed from pinned nodes")
+            held: dict[int, int] = {}
+            for sid, sess in self._sessions.items():
+                for n in sess.nodes:
+                    held[id(n)] = held.get(id(n), 0) + 1
+            for n in nodes:
+                if n.pins != held.get(id(n), 0):
+                    violations.append(
+                        f"node pins={n.pins} but {held.get(id(n), 0)} "
+                        f"live session(s) hold it")
+                    break  # one representative is enough detail
+            content = [n for n in nodes
+                       if (n.page_id is not None if self.pool is not None
+                           else n.kv is not None)]
+            content_bytes = sum(n.nbytes for n in content)
+            rep = self.stats_counters.report()
+            if len(content) != rep["blocks"]:
+                violations.append(
+                    f"blocks counter {rep['blocks']} != {len(content)} "
+                    f"content nodes in the tree")
+            if content_bytes != rep["bytes"]:
+                violations.append(
+                    f"bytes counter {rep['bytes']} != {content_bytes} "
+                    f"recomputed from content nodes")
+            if self.pool is not None:
+                refs = self.pool.snapshot_refs()
+                for n in content:
+                    if refs.get(n.page_id, 0) < 1:
+                        violations.append(
+                            f"tree references page {n.page_id} with no "
+                            f"live pool ref")
+                        break
+            return {
+                "ok": not violations,
+                "violations": violations,
+                "sessions_active": len(self._sessions),
+                "pinned_leaves": leaves,
+                "pinned_bytes": nbytes,
+                "blocks": len(content),
+                "bytes": content_bytes,
+                "paged": self.pool is not None,
+            }
+
     def stats(self) -> dict:
         out = self.stats_counters.report()
         out["block"] = self.block

@@ -238,7 +238,31 @@ class BundleServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _require_loopback(self) -> bool:
+                """Host-only endpoints (/v1/debug/*): refuse
+                non-loopback clients with a 403 BEFORE touching the
+                request body (the connection closes, so keep-alive
+                cannot misparse unread bytes). These surfaces expose a
+                fault-injection control plane and cache internals —
+                operator/debugger tools on the host, never a path a
+                fronting proxy should forward. /v1/kv/probe stays OPEN
+                like /v1/kv/export|import: it is part of the fleet KV
+                wire surface — the router's import-miss pull calls it
+                cross-host, and its error path deliberately reads a
+                refusal as blocks-present (plain dedup semantics), so
+                gating it would silently disable the pull."""
+                if self.client_address[0] in ("127.0.0.1", "::1"):
+                    return True
+                self.close_connection = True
+                self._send(403, {"ok": False, "error":
+                                 "host-only endpoint (loopback clients "
+                                 "only)"})
+                return False
+
             def do_GET(self):
+                if self.path == "/v1/debug/invariants":
+                    self._debug_invariants()
+                    return
                 if self.path == "/healthz":
                     # liveness vs readiness split: "ok" is liveness (the
                     # process answers — always 200 so watchdog tooling
@@ -455,6 +479,9 @@ class BundleServer:
                     return
                 if self.path == "/v1/kv/probe":
                     self._kv_probe()
+                    return
+                if self.path == "/v1/debug/faults":
+                    self._debug_faults()
                     return
                 if self.path == "/profile":
                     req = self._read_json()
@@ -771,12 +798,23 @@ class BundleServer:
                 requests). A malformed framing line raises ValueError;
                 a connection dying mid-chunk raises ConnectionError —
                 both roll the streaming import back."""
+                from lambdipy_tpu.runtime.kvwire import _MAX_CHUNK_BODY
+
                 while True:
                     line = self.rfile.readline(66)
                     if not line:
                         raise ConnectionError(
                             "connection closed mid-chunk-stream")
                     size = int(line.strip().split(b";")[0], 16)
+                    if size > _MAX_CHUNK_BODY + 4096:
+                        # the wire format already bounds what a chunk
+                        # may carry (kvwire validates nbody); bound the
+                        # HTTP-chunk allocation the same way, or a
+                        # hostile hex length buffers arbitrary bytes
+                        # BEFORE the validator ever sees one
+                        raise ValueError(
+                            f"chunk size {size} exceeds the KV stream "
+                            f"bound")
                     if size == 0:
                         self.rfile.readline()  # trailing CRLF
                         return
@@ -932,13 +970,60 @@ class BundleServer:
                 finally:
                     self._end_invoke(ticket, t0)
 
+            def _debug_invariants(self):
+                """GET /v1/debug/invariants (host-only): the cheap
+                invariant sweep — pagepool conservation, prefix-store
+                pin accounting — as pass/fail + detail JSON. The chaos
+                checker's quiesce probe; also a live debugging aid. No
+                admission gate: host-side accounting reads only."""
+                if not self._require_loopback():
+                    return
+                fn = getattr(server_self.boot.state,
+                             "debug_invariants_fn", None)
+                if fn is None:
+                    self._send(404, {"ok": False, "error":
+                                     "no invariants surface (handler "
+                                     "has no serve-path state)"})
+                    return
+                try:
+                    self._send(200, fn())
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"ok": False, "error": str(e)})
+
+            def _debug_faults(self):
+                """POST /v1/debug/faults (host-only): arm/clear fault
+                rules on the replica's live plan — the chaos soak's
+                nemesis control surface. The loopback check runs FIRST:
+                a control plane must not parse non-loopback bytes, and
+                the refusal closes the connection so the unread body
+                cannot poison keep-alive."""
+                if not self._require_loopback():
+                    return
+                request = self._read_json()
+                if request is None:
+                    return
+                fn = getattr(server_self.boot.state, "faults_admin_fn",
+                             None)
+                if fn is None:
+                    self._send(404, {"ok": False, "error":
+                                     "no fault-control surface "
+                                     "(unsupported handler)"})
+                    return
+                try:
+                    out = fn(request)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"ok": False, "error": str(e)})
+                    return
+                self._send(200 if out.get("ok") else 400, out)
+
             def _kv_probe(self):
-                """Host-only KV presence probe: how many head tokens the
-                radix tree actually holds. No admission gate — it is an
+                """KV presence probe: how many head tokens the radix
+                tree actually holds. No admission gate — it is an
                 O(depth) dict walk with no device work, and the router
-                calls it on the import-miss pull path where queueing
-                behind a run slot would cost more than the re-ship it
-                guards."""
+                calls it on the import-miss pull path (cross-host in a
+                multi-host fleet, so no loopback refusal — see
+                _require_loopback) where queueing behind a run slot
+                would cost more than the re-ship it guards."""
                 fn = getattr(server_self.boot.state, "kv_probe_fn", None)
                 request = self._read_json()
                 if request is None:

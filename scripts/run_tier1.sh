@@ -281,4 +281,24 @@ if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 13"
+
+# Phase 14: composed-fault chaos soak — bench.py --soak runs the fixed
+# CI seed set through the nemesis (1-3 overlapping fault-site events
+# from the runtime/faults.py registry, >= 1 worker SIGKILL and >= 1
+# drain per schedule) against a live 2-replica managed fleet (dense +
+# paged) under a seeded open-loop mixed workload, then re-runs the
+# first seed asserting a byte-identical timeline and identical verdict.
+# Exits nonzero on any silent loss (delivered-but-wrong bytes, or a
+# failure outside the priced-shed contract), an overlong waiter, a
+# quiesce invariant that fails to converge (pagepool/pin accounting,
+# spill depth), or a checker canary that fails to reject a
+# suppressed-shed history. A failing seed prints its timeline file for
+# one-command replay (bench.py --soak --seed N --replay-timeline F).
+phase_begin "phase 14: composed-fault chaos soak (bench.py --soak)"
+if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python bench.py --soak; then
+    echo "FATAL: bench.py --soak failed" >&2
+    exit 1
+fi
+phase_end "phase 14"
 exit 0

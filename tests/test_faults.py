@@ -749,3 +749,110 @@ def test_server_healthz_wedged_and_admission_accept_hole(tmp_path):
         assert faults["wedged"] is False
     finally:
         threading.Thread(target=server.stop, daemon=True).start()
+
+
+# -- the structured site registry (chaos-soak satellite) ---------------------
+
+
+def test_site_registry_metadata():
+    """Every site carries an owner, its arming env var, and a note; the
+    tuple view stays in sync; list_sites filters feed the nemesis menu
+    and the docs table."""
+    from lambdipy_tpu.runtime.faults import REGISTRY, SITES, list_sites
+
+    assert tuple(REGISTRY) == SITES
+    for site in REGISTRY.values():
+        assert site.owner in ("engine", "store", "pool", "router"), site
+        assert site.env in ("LAMBDIPY_FAULT", "LAMBDIPY_FLEET_FAULT")
+        assert site.note
+        # the env var follows the owner: replica-process sites arm via
+        # LAMBDIPY_FAULT, fleet-process sites via LAMBDIPY_FLEET_FAULT
+        want = ("LAMBDIPY_FAULT" if site.owner in ("engine", "store")
+                else "LAMBDIPY_FLEET_FAULT")
+        assert site.env == want, site
+    engine = {s.name for s in list_sites(owner="engine")}
+    assert "segment_fetch" in engine and "probe" not in engine
+    fleet = {s.name for s in list_sites(env="LAMBDIPY_FLEET_FAULT")}
+    assert "route_connect" in fleet and "prefix_walk" not in fleet
+
+
+def test_every_fire_site_in_the_tree_is_registered():
+    """Grep-based completeness: every literal fault-site reference in
+    lambdipy_tpu/ (``faults.check("x")`` and ``_device_wait("x", ...)``
+    call sites) names a registered site, and every registered site has
+    at least one call site — a new site cannot silently dodge the
+    chaos soak's registry-derived nemesis menu."""
+    import re
+    from pathlib import Path
+
+    from lambdipy_tpu.runtime.faults import REGISTRY
+
+    root = Path(__file__).resolve().parents[1] / "lambdipy_tpu"
+    check_re = re.compile(r"\.check\(\s*[\"']([a-z_]+)[\"']")
+    wait_re = re.compile(r"_device_wait\(\s*[\"']([a-z_]+)[\"']")
+    found: set = set()
+    for path in root.rglob("*.py"):
+        text = path.read_text()
+        found.update(check_re.findall(text))
+        found.update(wait_re.findall(text))
+    unregistered = found - set(REGISTRY)
+    assert not unregistered, (
+        f"fault sites fired in the tree but missing from the "
+        f"faults.py REGISTRY: {sorted(unregistered)}")
+    unfired = set(REGISTRY) - found
+    assert not unfired, (
+        f"registered fault sites with no check()/_device_wait() call "
+        f"site anywhere in lambdipy_tpu/: {sorted(unfired)}")
+
+
+# -- runtime arm/clear (the nemesis control surface) -------------------------
+
+
+def test_fault_plan_runtime_arm_and_clear():
+    plan = FaultPlan.empty()
+    assert not plan.armed()["active"]
+    added = plan.arm("transport:exception@n=1;probe:delay@ms=5,n=2")
+    assert len(added) == 2
+    with pytest.raises(InjectedFault):
+        plan.check("transport")
+    assert plan.clear() == 2
+    plan.check("transport")  # cleared: no-op fast path, no fire
+    # counters survived the clear (the deterministic replay spine)
+    assert plan.counts()["transport"] == 1
+    # a bad runtime spec touches nothing
+    with pytest.raises(ValueError):
+        plan.arm("transport:nope")
+    assert not plan.armed()["active"]
+
+
+def test_fault_plan_clear_releases_hangs_without_poisoning_later_ones():
+    """clear() resolves in-flight hangs (raising InjectedFault — an
+    abandoned wait must not look like success) while hangs armed LATER
+    still block: the release event is swapped, not left set."""
+    plan = FaultPlan.empty()
+    plan.arm("transport:hang")
+    results: list = []
+
+    def waiter(tag):
+        try:
+            plan.check("transport")
+            results.append((tag, "passed"))
+        except InjectedFault:
+            results.append((tag, "released"))
+
+    t1 = threading.Thread(target=waiter, args=("first",), daemon=True)
+    t1.start()
+    time.sleep(0.15)
+    plan.clear()
+    t1.join(5.0)
+    assert ("first", "released") in results
+    # re-arm: the fresh hang must actually block again
+    plan.arm("transport:hang")
+    t2 = threading.Thread(target=waiter, args=("second",), daemon=True)
+    t2.start()
+    t2.join(0.4)
+    assert t2.is_alive(), "a re-armed hang resolved instantly — the " \
+        "released event leaked into the new rule"
+    plan.release()
+    t2.join(5.0)
+    assert ("second", "released") in results

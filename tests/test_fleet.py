@@ -630,6 +630,15 @@ def test_pool_begin_drain_routes_away_immediately(stub_pair):
         for i in range(4):
             assert _post(f"{base}/invoke",
                          {"tokens": [i]})["replica"] == "r0"
+        # end_drain aborts the drain (the chaos nemesis's undrain, an
+        # operator changing their mind): r1 routes again, and a second
+        # end_drain on a non-draining replica is a no-op
+        pool.end_drain("r1")
+        assert pool.replicas["r1"].routable
+        pool.end_drain("r1")
+        seen = {_post(f"{base}/invoke", {"tokens": [i]})["replica"]
+                for i in range(8)}
+        assert "r1" in seen
     finally:
         router.stop()
 

@@ -503,3 +503,55 @@ def test_drain_reship_failure_leaves_turn_time_failover():
                 s.kill()
             except Exception:
                 pass
+
+
+def test_idle_session_records_expire_by_router_ttl(stub_pair):
+    """The router's sticky records honor an idle TTL (chaos-soak find:
+    replica-side pin LEASES expire on their own, but a router record
+    only ever died by cap pressure or DELETE, so the fleet session
+    gauge drifted from the real pinned state). A scrape alone runs the
+    lazy sweep; a fresh turn under the same id re-opens cleanly."""
+    s0, s1, pool = stub_pair
+    router = _router(pool, session_record_ttl_s=1.0)
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        row = list(range(1, 13))
+        _turn(base, "idle-conv", row)
+        rep = router.metrics()["fleet"]["sessions"]
+        assert rep["active"] == 1 and rep["record_expiries"] == 0
+        import time as _time
+
+        _time.sleep(1.2)
+        rep = router.metrics()["fleet"]["sessions"]
+        assert rep["active"] == 0, "idle record survived its TTL"
+        assert rep["record_expiries"] == 1
+        assert _get(f"{base}/healthz")["sessions"] == 0
+        # the session is not broken, just unsticky: the next turn
+        # places by prefix affinity and re-opens the record
+        _turn(base, "idle-conv", row)
+        rep = router.metrics()["fleet"]["sessions"]
+        assert rep["active"] == 1 and rep["opened"] == 2
+    finally:
+        router.stop()
+
+
+def test_active_session_records_survive_the_ttl_sweep(stub_pair):
+    """Touching a session (any turn) refreshes its record's clock: only
+    IDLE records expire — a live conversation's stickiness must never
+    lapse underneath it."""
+    s0, s1, pool = stub_pair
+    router = _router(pool, session_record_ttl_s=1.0)
+    try:
+        import time as _time
+
+        base = f"http://127.0.0.1:{router.port}"
+        row = list(range(1, 13))
+        home = _turn(base, "live-conv", row)["replica"]
+        for _ in range(3):  # turns keep arriving inside the TTL
+            _time.sleep(0.5)
+            row = row + [7] * 4
+            assert _turn(base, "live-conv", row)["replica"] == home
+        rep = router.metrics()["fleet"]["sessions"]
+        assert rep["active"] == 1 and rep["record_expiries"] == 0
+    finally:
+        router.stop()

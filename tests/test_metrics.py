@@ -562,3 +562,63 @@ def test_session_stats_drain_reships():
     st.count("drain_reships", 2)
     rep = st.report()
     assert rep["drain_reships"] == 2 and rep["reships"] == 0
+
+
+# -- faults.armed (the chaos-soak observability satellite) -------------------
+
+
+def test_fault_plan_armed_report_shape_and_remaining():
+    """faults.armed: sites/kinds/remaining fire counts for the live
+    plan — remaining decrements as rules fire, hang rules report inf,
+    and per-site call counters ride along."""
+    from lambdipy_tpu.runtime.faults import FaultPlan, InjectedFault
+
+    plan = FaultPlan.from_spec(
+        "transport:delay@ms=7,n=2;segment_fetch:hang")
+    armed = plan.armed()
+    assert armed["active"]
+    assert armed["sites"] == ["segment_fetch", "transport"]
+    by_site = {r["site"]: r for r in armed["rules"]}
+    assert by_site["transport"]["kind"] == "delay"
+    assert by_site["transport"]["ms"] == 7.0
+    assert by_site["transport"]["remaining"] == 2
+    assert by_site["segment_fetch"]["n"] == "inf"
+    assert by_site["segment_fetch"]["remaining"] == "inf"
+    plan.check("transport")  # fires the delay once
+    armed = plan.armed()
+    by_site = {r["site"]: r for r in armed["rules"]}
+    assert by_site["transport"]["fired"] == 1
+    assert by_site["transport"]["remaining"] == 1
+    assert armed["counts"] == {"transport": 1}
+    assert not FaultPlan.empty().armed()["active"]
+
+
+def test_router_metrics_exposes_armed_faults():
+    """The fleet /metrics document carries the router process's live
+    plan under faults.armed — a soak run (or a stray
+    LAMBDIPY_FLEET_FAULT) is visible at the front door; a distinct
+    pool plan reports alongside."""
+    from lambdipy_tpu.fleet import FleetRouter, ReplicaPool
+    from lambdipy_tpu.runtime.faults import FaultPlan
+
+    plan = FaultPlan.from_spec("route_connect:exception@n=3")
+    pool = ReplicaPool(faults=plan)
+    router = FleetRouter(pool, faults=plan)
+    try:
+        armed = router.metrics()["faults"]
+        assert armed["armed"]["active"]
+        assert armed["armed"]["sites"] == ["route_connect"]
+        assert "pool_armed" not in armed  # shared plan: one report
+    finally:
+        router._httpd.server_close()
+        pool.close()
+    probe_plan = FaultPlan.from_spec("probe:exception@n=1")
+    pool2 = ReplicaPool(faults=probe_plan)
+    router2 = FleetRouter(pool2, faults=FaultPlan.empty())
+    try:
+        armed = router2.metrics()["faults"]
+        assert not armed["armed"]["active"]
+        assert armed["pool_armed"]["sites"] == ["probe"]
+    finally:
+        router2._httpd.server_close()
+        pool2.close()
