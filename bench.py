@@ -1205,6 +1205,341 @@ def disagg_rtt_record(*, block: int = 32, max_len: int = 1024,
     return result
 
 
+def autoscale_record(*, block: int = 64, burst_len: int = 449,
+                     walk_ms: float = 90.0, n_new: int = 8,
+                     trigger_s: float = 3.5, window_s: float = 7.0,
+                     burst_interval_ms: float = 600.0,
+                     probe_interval_ms: float = 150.0,
+                     slo_p99_ms: float = 200.0,
+                     max_p99_ratio: float = 0.7,
+                     dry_run_s: float = 2.5) -> dict:
+    """Elastic control-plane sweep (CPU-runnable, SUBPROCESS replicas):
+    an open-loop prefill-burst spike against a 2-replica MIXED fleet,
+    with and without ``FleetController`` closing the loop. Three hard
+    gates:
+
+    1. RECOVERY — the controller must PROMOTE one mixed replica to the
+       prefill class under the sustained queue-wait breach, and the
+       autoscaled fleet's interactive queue-wait P99 (measured client-
+       side from the ``queue_wait_ms`` response echo, after
+       ``trigger_s``) must be <= ``max_p99_ratio`` x the static fleet's
+       under the identical workload. Every delivered interactive answer
+       is checked BITWISE against the direct per-replica reference, and
+       the zero-loss bar holds through the live role flip: issued ==
+       delivered + priced sheds, nothing silent.
+    2. DETERMINISM — ``replay_decisions()`` re-runs the pure policy
+       over the live snapshots with a fresh state and must reproduce
+       the decision trace byte-for-byte.
+    3. DRY RUN — a controller in ``dry_run`` mode over the same
+       (pressured) fleet logs promote INTENTS but fires no actuator:
+       zero applied actions, zero events, every role still mixed.
+    """
+    import tempfile
+    import urllib.error
+    import urllib.request
+    from pathlib import Path
+
+    import numpy as np
+
+    from lambdipy_tpu.fleet import (MIXED, PREFILL, FleetController,
+                                    FleetRouter, PolicyConfig, ReplicaPool)
+
+    tmp = Path(tempfile.mkdtemp(prefix="lambdipy-autoscale-bench-"))
+    bundle = _build_disagg_bundle(tmp, n_new=n_new, block=block,
+                                  name="autoscale-bench")
+    rng = np.random.default_rng(2)
+    env_extra = {"LAMBDIPY_FAULT":
+                 f"prefix_walk:delay@ms={walk_ms:g},n=inf"}
+
+    def post(base, path, payload, *, headers=None, timeout=300):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     **(headers or {})}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def completion(base, row, *, max_tokens, headers=None):
+        out = post(base, "/v1/completions",
+                   {"prompt": [int(t) for t in row],
+                    "max_tokens": max_tokens, "temperature": 0},
+                   headers=headers)
+        return out["choices"][0]["tokens"], out.get("queue_wait_ms")
+
+    def boot_pair(tag):
+        out = [None, None]
+        errs: list = []
+
+        def boot(i):
+            try:
+                out[i] = _spawn_replica_proc(bundle, env_extra=env_extra,
+                                             tag=f"{tag}{i}")
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                errs.append(e)
+
+        threads = [threading.Thread(target=boot, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            for rec in out:
+                if rec is not None:
+                    rec[0].kill()
+            raise errs[0]
+        return out
+
+    def mk_fleet(specs):
+        pool = ReplicaPool(probe_interval=0.5, fail_threshold=2,
+                           probe_timeout=10.0)
+        for name, url in specs:
+            pool.attach(name, url, role=MIXED)
+        pool.probe_all()
+        pool.start()
+        router = FleetRouter(pool, affinity_on=True, block=block,
+                             max_retries=2, request_timeout=300)
+        return router.start_background(), pool
+
+    def bench_policy():
+        # promote-only shape: util_low=0 makes demote/retire impossible
+        # (no util is < 0), so the measured leg isolates ONE promote
+        # instead of flapping; short sustain/cooldown fit the window
+        return PolicyConfig(slo_p99_ms=slo_p99_ms,
+                            slo_class="interactive", hysteresis=0.2,
+                            sustain_s=0.6, lifecycle_cooldown_s=6.0,
+                            knob_cooldown_s=2.0, live_floor=1,
+                            min_replicas=2, max_prefill=1, util_low=0.0)
+
+    # the interactive rows: one shared warm prefix + distinct suffixes
+    # (all land on ONE affinity target — the lane the burst squeezes)
+    prefix = _disagg_rows(rng, n=1, length=block)[0]
+    rows = [prefix + _disagg_rows(rng, n=1, length=8)[0]
+            for _ in range(32)]
+
+    def warm_refs(urls):
+        """Direct per-replica references: warms the prefix radix on
+        BOTH replicas (so the role flip never strands affinity on a
+        cold store) and pins the bitwise bar for every delivered
+        interactive answer; also compiles the burst-shaped cold-walk
+        program on both so neither measured leg pays a first-use
+        compile."""
+        per = []
+        for url in urls:
+            per.append([completion(url, row, max_tokens=n_new)[0]
+                        for row in rows])
+            completion(url, _disagg_rows(rng, n=1, length=burst_len)[0],
+                       max_tokens=1)
+        if per[0] != per[1]:
+            raise AssertionError(
+                "autoscale: replica pair is not bitwise identical — "
+                "the parity bar below would be meaningless")
+        return per[0]
+
+    def run_leg(base, refs):
+        """One open-loop window: interactive probes every
+        ``probe_interval_ms`` (default lane), cold prefill bursts every
+        ``burst_interval_ms`` (batch lane), all fired on timers
+        regardless of completion — a closed loop would self-pace to the
+        slower fleet and offer it LESS load, backwards for a recovery
+        comparison. Returns (samples, accounting)."""
+        lock = threading.Lock()
+        samples: list = []      # (t_issued_s, queue_wait_ms)
+        losses: list = []
+        sheds = [0]
+        issued = {"probes": 0, "bursts": 0}
+        threads: list = []
+
+        def classify(e, what):
+            if isinstance(e, urllib.error.HTTPError) \
+                    and e.code in (429, 503, 504) \
+                    and e.headers.get("Retry-After"):
+                with lock:
+                    sheds[0] += 1
+                return
+            with lock:
+                losses.append(f"{what}: {type(e).__name__}: {e}")
+
+        def probe_once(i, t_issue):
+            try:
+                toks, wait = completion(base, rows[i % len(rows)],
+                                        max_tokens=n_new)
+                if toks != refs[i % len(rows)]:
+                    with lock:
+                        losses.append(f"probe {i}: tokens diverged")
+                    return
+                if wait is not None:
+                    with lock:
+                        samples.append((t_issue, float(wait)))
+            except Exception as e:  # noqa: BLE001 — classified below
+                classify(e, f"probe {i}")
+
+        def burst_once(j, row):
+            try:
+                completion(base, row, max_tokens=1,
+                           headers={"x-priority": "batch"})
+            except Exception as e:  # noqa: BLE001 — classified below
+                classify(e, f"burst {j}")
+
+        # one scheduler thread owns the shared rng and both timers
+        t0 = time.monotonic()
+        next_probe, next_burst, i = 0.0, 0.0, 0
+        while True:
+            now = time.monotonic() - t0
+            if now >= window_s:
+                break
+            if now >= next_burst:
+                row = _disagg_rows(rng, n=1, length=burst_len)[0]
+                th = threading.Thread(
+                    target=burst_once, args=(issued["bursts"], row),
+                    daemon=True)
+                th.start()
+                threads.append(th)
+                issued["bursts"] += 1
+                next_burst += burst_interval_ms / 1e3
+            if now >= next_probe:
+                th = threading.Thread(target=probe_once, args=(i, now),
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+                i += 1
+                issued["probes"] += 1
+                next_probe += probe_interval_ms / 1e3
+            time.sleep(0.01)
+        for th in threads:  # zero-loss: every issued request completes
+            th.join(timeout=120)
+        if any(th.is_alive() for th in threads):
+            losses.append("wedged: a request never completed")
+        if losses:
+            raise AssertionError(
+                f"autoscale: silent losses under the spike: "
+                f"{losses[:3]}")
+        tail = sorted(w for ts, w in samples if ts >= trigger_s)
+        if len(tail) < 8:
+            raise AssertionError(
+                f"autoscale: only {len(tail)} post-trigger samples — "
+                f"the window measured nothing")
+        p99 = tail[min(len(tail) - 1, int(0.99 * len(tail)))]
+        acct = {"probes_issued": issued["probes"],
+                "bursts_issued": issued["bursts"],
+                "priced_sheds": sheds[0],
+                "delivered": issued["probes"] + issued["bursts"]
+                - sheds[0],
+                "samples": len(samples), "tail_samples": len(tail),
+                "p99_queue_wait_ms": round(p99, 1),
+                "p50_queue_wait_ms": round(tail[len(tail) // 2], 1)}
+        return p99, acct
+
+    result: dict = {"mode": "autoscale", "block": block,
+                    "burst_len": burst_len, "walk_ms": walk_ms,
+                    "window_s": window_s, "trigger_s": trigger_s,
+                    "slo_p99_ms": slo_p99_ms,
+                    "max_p99_ratio": max_p99_ratio}
+
+    # ---- leg 1+2: STATIC baseline, then DRY RUN on its pressure -----
+    (p0, url0, _), (p1, url1, _) = boot_pair("st")
+    try:
+        refs = warm_refs((url0, url1))
+        router, pool = mk_fleet([("st0", url0), ("st1", url1)])
+        try:
+            p99_static, result["static"] = run_leg(
+                f"http://127.0.0.1:{router.port}", refs)
+        finally:
+            router.stop()
+            pool.close()
+        # the replicas' queue-wait reservoirs still hold the static
+        # leg's breach — a dry-run controller over them must INTEND
+        # the promote without touching anything
+        router, pool = mk_fleet([("st0", url0), ("st1", url1)])
+        ctrl = FleetController(router, config=bench_policy(),
+                               interval_s=0.2, dry_run=True).start()
+        try:
+            time.sleep(dry_run_s)
+            rep = ctrl.report()
+            roles = sorted(r.role for r in pool.replicas.values())
+            if rep["intents"].get("promote", 0) < 1:
+                raise AssertionError(
+                    f"autoscale dry-run: no promote intent logged "
+                    f"under a breached fleet: {rep}")
+            if rep["actions"]:
+                raise AssertionError(
+                    f"autoscale dry-run: an actuator fired: "
+                    f"{rep['actions']}")
+            if rep["events"] or roles != [MIXED, MIXED]:
+                raise AssertionError(
+                    f"autoscale dry-run: the fleet changed "
+                    f"(events={rep['events']}, roles={roles})")
+            result["dry_run"] = {"intents": rep["intents"],
+                                 "ticks": rep["ticks"], "acted": False}
+        finally:
+            ctrl.close()
+            router.stop()
+            pool.close()
+    finally:
+        for p in (p0, p1):
+            p.kill()
+
+    # ---- leg 3: AUTOSCALED — same workload, controller live ---------
+    (p0, url0, _), (p1, url1, _) = boot_pair("au")
+    try:
+        refs = warm_refs((url0, url1))
+        router, pool = mk_fleet([("au0", url0), ("au1", url1)])
+        ctrl = FleetController(router, config=bench_policy(),
+                               interval_s=0.25).start()
+        try:
+            p99_auto, result["autoscale"] = run_leg(
+                f"http://127.0.0.1:{router.port}", refs)
+            rep = ctrl.report()
+            roles = sorted(r.role for r in pool.replicas.values())
+            if rep["actions"].get("promote", 0) < 1 \
+                    or PREFILL not in roles:
+                raise AssertionError(
+                    f"autoscale: the controller never promoted a "
+                    f"prefill replica (actions={rep['actions']}, "
+                    f"roles={roles})")
+            bad = [e["event"] for e in rep["events"]
+                   if not e["event"].startswith("@")]
+            if bad:
+                raise AssertionError(
+                    f"autoscale: events out of the nemesis grammar: "
+                    f"{bad}")
+            if not ctrl.replay_decisions():
+                raise AssertionError(
+                    "autoscale: the decision trace is not reproducible "
+                    "from its snapshots — the policy leaked impurity")
+            result["autoscale"]["controller"] = {
+                "actions": rep["actions"], "intents": rep["intents"],
+                "ticks": rep["ticks"], "errors": rep["errors"],
+                "events": [e["event"] for e in rep["events"]],
+                "replay_identical": True}
+            result["autoscale"]["roles"] = roles
+        finally:
+            ctrl.close()
+            router.stop()
+            pool.close()
+    finally:
+        for p in (p0, p1):
+            p.kill()
+
+    ratio = p99_auto / max(1e-9, p99_static)
+    result["p99_ratio"] = round(ratio, 3)
+    if p99_static <= slo_p99_ms:
+        raise AssertionError(
+            f"autoscale: the static fleet never breached the SLO "
+            f"(p99 {p99_static:.0f}ms <= {slo_p99_ms:.0f}ms) — the "
+            f"spike tested nothing")
+    if ratio > max_p99_ratio:
+        raise AssertionError(
+            f"autoscale: P99 queue-wait recovered to only "
+            f"{ratio:.2f}x static (gate <= {max_p99_ratio}x): "
+            f"{result}")
+    result["passed"] = True
+    import jax
+
+    result["platform"] = jax.devices()[0].platform
+    return result
+
+
 def _build_sessions_bundle(tmp, *, n_new: int, block: int,
                            name: str = "sessions-bench"):
     """The tiny llama bundle the sessions sweep serves: continuous
@@ -3150,6 +3485,34 @@ def _sessions_main() -> int:
     return 0
 
 
+def _autoscale_main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--autoscale", action="store_true")
+    ap.add_argument("--block", type=int, default=64)
+    ap.add_argument("--burst-len", type=int, default=449)
+    ap.add_argument("--walk-ms", type=float, default=90.0)
+    ap.add_argument("--n-new", type=int, default=8)
+    ap.add_argument("--trigger-s", type=float, default=3.5)
+    ap.add_argument("--window-s", type=float, default=7.0)
+    ap.add_argument("--burst-interval-ms", type=float, default=600.0)
+    ap.add_argument("--probe-interval-ms", type=float, default=150.0)
+    ap.add_argument("--slo-p99-ms", type=float, default=200.0)
+    ap.add_argument("--max-p99-ratio", type=float, default=0.7)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(autoscale_record(
+        block=args.block, burst_len=args.burst_len,
+        walk_ms=args.walk_ms, n_new=args.n_new,
+        trigger_s=args.trigger_s, window_s=args.window_s,
+        burst_interval_ms=args.burst_interval_ms,
+        probe_interval_ms=args.probe_interval_ms,
+        slo_p99_ms=args.slo_p99_ms,
+        max_p99_ratio=args.max_p99_ratio)))
+    return 0
+
+
 def _chaos_fleet_main() -> int:
     import argparse
 
@@ -3183,6 +3546,10 @@ def _soak_main() -> int:
                     help="timeline file from a failing run: replay its "
                          "exact schedule under --seed's workload")
     ap.add_argument("--no-determinism", action="store_true")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the live FleetController over the soak "
+                         "fleet: its resizes join the nemesis timeline "
+                         "and the zero-loss bar must hold through them")
     args = ap.parse_args()
     _enable_compile_cache()
     from lambdipy_tpu.chaos.soak import soak_record
@@ -3200,7 +3567,8 @@ def _soak_main() -> int:
     determinism = (not args.no_determinism and args.seed is None
                    and replay is None)
     print(json.dumps(soak_record(seeds=seeds, replay_timeline=replay,
-                                 determinism=determinism, **kwargs)))
+                                 determinism=determinism,
+                                 autoscale=args.autoscale, **kwargs)))
     return 0
 
 
@@ -3515,6 +3883,15 @@ def main() -> int:
         # nonzero on any violation, printing the seed + timeline for
         # one-command replay.
         return _soak_main()
+    if "--autoscale" in sys.argv:
+        # CPU-runnable elastic control-plane sweep (subprocess
+        # replicas): an open-loop prefill spike against a 2-replica
+        # mixed fleet — the live controller must promote a prefill
+        # replica and recover interactive queue-wait P99 to <= 0.7x
+        # the static fleet's, with bitwise delivery, zero silent
+        # losses through the role flip, a byte-identical decision
+        # replay, and a dry-run leg proving intents never actuate
+        return _autoscale_main()
     if "--chaos-fleet" in sys.argv:
         # CPU-runnable fleet-boundary chaos matrix: router-side network
         # faults (drop/latency/mid-body/flap) + a fleet-wide shed burst

@@ -142,7 +142,8 @@ class SoakFleet:
 
     def __init__(self, *, block: int = 32, n_new: int = 8,
                  max_len: int = 256, request_timeout: float = 40.0,
-                 spill_max_wait_s: float = 20.0):
+                 spill_max_wait_s: float = 20.0,
+                 autoscale: bool = False):
         import tempfile
 
         from lambdipy_tpu.fleet import FleetRouter, ReplicaPool
@@ -151,6 +152,7 @@ class SoakFleet:
         from lambdipy_tpu.runtime.server import BundleServer
 
         self.block, self.n_new = block, n_new
+        self.controller = None  # set below; None-safe for early close()
         self.tmp = Path(tempfile.mkdtemp(prefix="lambdipy-soak-"))
         self.bundle = _build_soak_bundle(self.tmp, n_new=n_new,
                                          block=block, max_len=max_len)
@@ -213,6 +215,22 @@ class SoakFleet:
             faults=self.router_plan).start_background()
         self.base = f"http://127.0.0.1:{self.router.port}"
         self.ops = LiveFleetOps(self.pool, self.router_plan)
+        # opt-in elastic control loop UNDER the nemesis: controller
+        # actions land in self.controller.events (the same @T grammar
+        # as the timeline) so a window can interleave self-resizing
+        # with injected faults and still hold the zero-loss oracle.
+        # min_replicas=2 pins the loop to reshaping (promote/demote),
+        # never shrinking the 2-replica soak fleet.
+        if autoscale:
+            from lambdipy_tpu.fleet import FleetController, PolicyConfig
+
+            self.controller = FleetController(
+                self.router,
+                config=PolicyConfig(slo_p99_ms=500.0, sustain_s=2.0,
+                                    lifecycle_cooldown_s=8.0,
+                                    min_replicas=2, max_prefill=1,
+                                    live_floor=1),
+                interval_s=0.5).start()
 
     # -- plumbing -------------------------------------------------------------
 
@@ -267,6 +285,11 @@ class SoakFleet:
         return inv, rm, per_replica
 
     def close(self) -> None:
+        if self.controller is not None:
+            try:
+                self.controller.close()
+            except Exception:  # noqa: BLE001
+                pass
         try:
             self.router.stop()
         except Exception:  # noqa: BLE001
@@ -355,6 +378,8 @@ def run_window(fleet: SoakFleet, *, seed: int, duration_s: float,
               duration_s=duration_s, requests=len(plan.all_requests()),
               **props)
     t_window = time.monotonic()
+    ctrl_ev0 = (len(fleet.controller.events)
+                if fleet.controller is not None else 0)
     nemesis = Nemesis(timeline, fleet.ops).start()
     outcomes = run_workload(
         fleet.base, plan, timeout_s=waiter_bound_s,
@@ -464,6 +489,13 @@ def run_window(fleet: SoakFleet, *, seed: int, duration_s: float,
         "timeline_props": props,
         "nemesis_applied": len(nemesis.applied),
         "nemesis_errors": applied_errors,
+        # controller-initiated resizes that landed during this window,
+        # in the nemesis event grammar — the self-tuning loop's actions
+        # sit on the same timeline as the injected faults, and the
+        # zero-loss oracle above already judged the history THROUGH them
+        "controller_events": (
+            [e["event"] for e in fleet.controller.events[ctrl_ev0:]]
+            if fleet.controller is not None else []),
         "recovery_s": round(recovery_s, 2),
         "spill_depth": quiesce["spill_depth"],
         "canary": canary,
@@ -475,7 +507,8 @@ def run_window(fleet: SoakFleet, *, seed: int, duration_s: float,
 def soak_record(*, seeds=(11, 23), duration_s: float = 22.0,
                 waiter_bound_s: float = 90.0,
                 replay_timeline: str | None = None,
-                determinism: bool = True) -> dict:
+                determinism: bool = True,
+                autoscale: bool = False) -> dict:
     """The ``bench.py --soak`` entry point. CI mode (defaults): run the
     fixed seed set, then re-run the FIRST seed and assert a
     byte-identical timeline with an identical verdict (schedule
@@ -492,7 +525,7 @@ def soak_record(*, seeds=(11, 23), duration_s: float = 22.0,
         raise ValueError(
             f"--soak-seconds {duration_s:.0f} is too short for the "
             f"composed-fault floor; use >= 12 s")
-    fleet = SoakFleet()
+    fleet = SoakFleet(autoscale=autoscale)
     try:
         timeline = None
         if replay_timeline is not None:
@@ -530,6 +563,7 @@ def soak_record(*, seeds=(11, 23), duration_s: float = 22.0,
             "seeds": list(seeds),
             "duration_s": duration_s,
             "replayed": replay_timeline is not None,
+            "autoscale": autoscale,
             "windows": windows,
             "determinism": determinism_rec,
             "passed": True,

@@ -667,12 +667,30 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
                    "ship (each flushed as its prefill chunk completes, "
                    "so cross-host transfer hides under the remaining "
                    "prefill); 0 = the blocking single-frame ship")
+@click.option("--autoscale/--no-autoscale", default=False, show_default=True,
+              help="close the control loop: a FleetController scrapes "
+                   "the fleet's own /metrics and promotes/demotes "
+                   "replica classes, spawns/retires replicas, and "
+                   "retunes pipeline_depth/spec_k/ship-window from the "
+                   "published signals (hysteresis + cooldown built in; "
+                   "decisions trace under fleet.controller in /metrics)")
+@click.option("--autoscale-dry-run", is_flag=True, default=False,
+              help="run the control loop but only LOG decisions as "
+                   "intents — no lifecycle action or knob write fires; "
+                   "the recommended first step in a new deployment")
+@click.option("--slo-p99-ms", type=float, default=250.0, show_default=True,
+              help="autoscale target: fleet-level interactive queue-wait "
+                   "P99 the controller steers toward")
+@click.option("--autoscale-interval", type=float, default=5.0,
+              show_default=True,
+              help="seconds between controller ticks (scrape + decide)")
 def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
               affinity, block, probe_interval, fail_threshold,
               readmit_passes, retries, saturation, hedge, timeout,
               engine_watchdog, attach_urls, spill_cap, spill_max_wait,
               breaker_fails, breaker_open_s, retry_budget, fault_spec,
-              session_pin_budget, session_ttl, ship_window):
+              session_pin_budget, session_ttl, ship_window, autoscale,
+              autoscale_dry_run, slo_p99_ms, autoscale_interval):
     """Serve a bundle from N supervised replicas behind one router.
 
     Spawns REPLICAS watchdogged deployments of BUNDLE, health-probes
@@ -775,6 +793,27 @@ def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
                              retry_budget=retry_budget,
                              ship_window=ship_window,
                              faults=fleet_faults)
+        controller = None
+        if autoscale or autoscale_dry_run:
+            from lambdipy_tpu.fleet import FleetController, PolicyConfig
+
+            spawner = None
+            if bundle_dir is not None:
+                counter = iter(range(len(spawned), 10_000))
+
+                def spawner(role):
+                    nm = f"{fleet_name}-a{next(counter)}"
+                    pool.spawn(nm, bundle_dir, runtime=runtime,
+                               env=replica_env, ready_timeout=timeout,
+                               role=role)
+                    return nm
+
+            controller = FleetController(
+                router,
+                config=PolicyConfig(slo_p99_ms=slo_p99_ms),
+                interval_s=autoscale_interval,
+                dry_run=autoscale_dry_run,
+                spawner=spawner).start()
     except BaseException:
         # a half-spawned fleet must not leak processes — including on
         # Ctrl-C, which lands mid-boot more often than anywhere else
@@ -790,6 +829,9 @@ def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
         "affinity": affinity, "block": block,
         "spill_cap": spill_cap, "breaker_fails": breaker_fails,
         "retry_budget": retry_budget,
+        "autoscale": bool(autoscale or autoscale_dry_run),
+        "autoscale_dry_run": bool(autoscale_dry_run),
+        "slo_p99_ms": slo_p99_ms,
         "urls": {r.name: r.url for r in spawned},
     }))
 
@@ -802,6 +844,8 @@ def fleet_cmd(bundle, replicas, prefill_replicas, port, name, registry_dir,
     except KeyboardInterrupt:
         pass
     finally:
+        if controller is not None:
+            controller.close()
         pool.stop_all()
 
 

@@ -5,9 +5,11 @@ supervised bundle servers — see pool.py (spawn/attach/probe/eject/
 readmit/rolling drain), affinity.py (rendezvous hashing over leading
 token blocks, matching the radix prefix cache), router.py (the HTTP
 front-door with retry/hedge/spill/metrics-aggregation), breaker.py
-(per-replica circuit breakers + the fleet-wide retry budget), and
-spill.py (the router-level overload parking lot built from the sched
-layer's queue/policy pieces).
+(per-replica circuit breakers + the fleet-wide retry budget), spill.py
+(the router-level overload parking lot built from the sched layer's
+queue/policy pieces), and policy.py + controller.py (the elastic
+control loop: pure decisions over the published signals, acted through
+the pool/router's own safe primitives).
 """
 
 from lambdipy_tpu.fleet.affinity import (
@@ -18,6 +20,15 @@ from lambdipy_tpu.fleet.affinity import (
 )
 from lambdipy_tpu.fleet.affinity import ship_prompt
 from lambdipy_tpu.fleet.breaker import CircuitBreaker, RetryBudget
+from lambdipy_tpu.fleet.controller import FleetController
+from lambdipy_tpu.fleet.policy import (
+    Action,
+    PolicyConfig,
+    PolicyState,
+    ReplicaView,
+    Snapshot,
+    decide,
+)
 from lambdipy_tpu.fleet.pool import (
     CLASSES,
     DECODE,
@@ -45,13 +56,20 @@ __all__ = [
     "PREFILL",
     "READY",
     "STOPPED",
+    "Action",
     "CircuitBreaker",
+    "FleetController",
     "FleetError",
     "FleetRouter",
+    "PolicyConfig",
+    "PolicyState",
     "Replica",
     "ReplicaPool",
+    "ReplicaView",
     "RetryBudget",
+    "Snapshot",
     "SpillQueue",
+    "decide",
     "parse_attach_spec",
     "pick_replica",
     "prefix_key",

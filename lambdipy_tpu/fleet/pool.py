@@ -445,7 +445,71 @@ class ReplicaPool:
             if r.state == DRAINING:
                 r.state = READY
 
+    def set_role(self, name: str, role: str, *, reship: bool = True) -> Replica:
+        """Flip a replica's class (promote a mixed replica to prefill,
+        demote it back, ...). The class is a ROUTER-SIDE attribute — the
+        replica process never knew it — so no restart is needed: the
+        replica goes transiently DRAINING (the router stops picking it),
+        the ``on_drain`` hook re-ships its pinned sessions to their
+        rendezvous successors while it still serves, and the role flips.
+        Works for managed AND attached replicas: unlike
+        :meth:`begin_drain`, the drain here is transient by construction
+        (this method itself ends it), so the probe-only-lifecycle
+        objection does not apply."""
+        if role not in CLASSES:
+            raise FleetError(
+                f"unknown replica class {role!r} (want one of {CLASSES})")
+        with self._lock:
+            r = self.replicas[name]
+            if r.state == STOPPED:
+                raise FleetError(f"replica {name!r} is stopped")
+            if r.role == role:
+                return r
+            prev = r.role
+            hook = self.on_drain if reship else None
+            restore = r.state == READY
+            if restore:
+                r.state = DRAINING
+        if hook is not None:
+            try:  # synchronous: export while the old home still serves
+                hook(r)
+            except Exception:  # noqa: BLE001 — re-ship is advisory
+                log_event(log, "on_drain hook failed", name=name)
+        with self._lock:
+            r.role = role
+            # only undo OUR transient drain: a concurrent ejection (or a
+            # real begin_drain racing in) keeps its state
+            if restore and r.state == DRAINING:
+                r.state = READY
+        log_event(log, "replica role changed", name=name, prev=prev,
+                  role=role)
+        return r
+
     # -- lifecycle ----------------------------------------------------------
+
+    def retire(self, name: str, *, grace: float = 10.0) -> None:
+        """Permanently remove ONE managed replica (fleet downsize):
+        drain — which fires the proactive session re-ship — then stop
+        the deployment and mark it STOPPED so probes and routing skip
+        it for good. The raw actuator only: floor enforcement
+        (live_floor, min_replicas) is the policy layer's job."""
+        with self._lock:
+            r = self.replicas[name]
+            if not r.managed:
+                raise FleetError(
+                    f"replica {name!r} is attached (unmanaged): this pool "
+                    f"cannot retire a process it does not own")
+            if r.state == STOPPED:
+                return
+        self.begin_drain(name)
+        if self.runtime is not None:
+            try:
+                self.runtime.stop(name, grace=grace)
+            except Exception:  # noqa: BLE001 — mark stopped regardless
+                log_event(log, "retire: runtime stop failed", name=name)
+        with self._lock:
+            r.state = STOPPED
+        log_event(log, "replica retired", name=name)
 
     def rolling_restart(self, *, live_floor: int = 1,
                         ready_timeout: float = 300.0,

@@ -240,6 +240,9 @@ class FleetRouter:
         pool.on_drain = self._on_replica_drain
         self._rr = 0  # tie-break rotation for least-outstanding picks
         self._rr_lock = threading.Lock()
+        # the elastic control loop (fleet/controller.py) registers
+        # itself here; when present its report rides the fleet /metrics
+        self.controller = None
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
@@ -1726,6 +1729,41 @@ class FleetRouter:
                       "outstanding": v["outstanding"]}
                 for cls, v in sorted(totals.items())}
 
+    @staticmethod
+    def _fold_queue_wait(per_replica: dict) -> dict:
+        """Fleet-level per-class queue-wait percentiles from the
+        replicas' own ``sched.queue_wait`` reservoirs, so an SLO
+        comparison reads ONE number instead of re-deriving it per
+        replica. ``p50_ms`` is the count-weighted mean of the replica
+        medians (a center estimate); ``p99_ms`` is the MAX of the
+        replica p99s — a sound upper bound on the union's p99: if every
+        replica's p99 <= M then at most 1% of each replica's samples
+        exceed M, so at most 1% of the union does. The SLO check is a
+        "worst lane a request class can land in" comparison, which is
+        exactly the conservative reading an autoscaler wants."""
+        agg: dict = {}
+        for name in sorted(per_replica):
+            m = per_replica[name]
+            if not isinstance(m, dict):
+                continue
+            qw = (m.get("sched") or {}).get("queue_wait")
+            if not isinstance(qw, dict):
+                continue
+            for cls, w in qw.items():
+                if not isinstance(w, dict) or not w.get("count"):
+                    continue
+                n = int(w["count"])
+                cur = agg.setdefault(cls, {"count": 0, "_p50_wsum": 0.0,
+                                           "p99_ms": 0.0})
+                cur["count"] += n
+                cur["_p50_wsum"] += n * float(w.get("p50_ms", 0.0))
+                cur["p99_ms"] = max(cur["p99_ms"],
+                                    float(w.get("p99_ms", 0.0)))
+        return {cls: {"count": c["count"],
+                      "p50_ms": round(c["_p50_wsum"] / c["count"], 3),
+                      "p99_ms": round(c["p99_ms"], 3)}
+                for cls, c in sorted(agg.items())}
+
     def metrics(self) -> dict:
         # replica scrapes fan out like the pool's probes: one wedged
         # replica must cost its own timeout, not add probe_timeout
@@ -1789,6 +1827,7 @@ class FleetRouter:
                         ship_agg[k] += int(dg.get(k, 0) or 0)
         total = agg["hits"] + agg["misses"]
         routable = self.pool.routable()
+        queue_wait = self._fold_queue_wait(per_replica)
         router_rep = self.stats.report()
         if self.spill is not None:
             # live gauges (depth, wait percentiles, drain estimate)
@@ -1816,6 +1855,10 @@ class FleetRouter:
                 },
                 "spec_standdown": {"total": sd_total,
                                    "reasons": sd_reasons},
+                # fleet-level per-class queue-wait percentiles folded
+                # from the replicas' sched reservoirs — the SLO signal
+                # the elastic controller compares against its target
+                "queue_wait": queue_wait,
                 # sticky multi-turn sessions: open records + sticky/
                 # failover/re-ship counters
                 # gauge FIRST: the live count runs the lazy TTL sweep,
@@ -1836,6 +1879,11 @@ class FleetRouter:
                     "classes": self._class_counts(),
                     "replicas": ship_agg,
                 },
+                # the elastic control loop's surface (action counters,
+                # last-decision trace, current targets) — only present
+                # when a FleetController registered itself
+                **({"controller": self.controller.report()}
+                   if self.controller is not None else {}),
             },
             # faults.armed: the ROUTER process's live injection plan
             # (route_*/probe/kv_ship* sites) — a soak run or a stray

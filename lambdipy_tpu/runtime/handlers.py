@@ -83,6 +83,13 @@ class HandlerState:
     # the chaos soak's nemesis arms composed faults on a timeline
     # through this instead of restarting the process per spec.
     faults_admin_fn: Callable[[dict], dict] | None = None
+    # optional host-only live-knob control (POST /v1/debug/knobs): the
+    # elastic fleet controller retunes a serving replica's
+    # pipeline_depth / spec_k from its own published signals. Both
+    # knobs are read per-dispatch by the continuous engine, so a live
+    # write is race-free; the handler clamps/buckets and refuses what
+    # the boot config never enabled.
+    knobs_admin_fn: Callable[[dict], dict] | None = None
 
     def invoke(self, request: dict) -> dict:
         t0 = time.monotonic()
@@ -910,6 +917,57 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
         return {"ok": True, "added": added,
                 "armed": live_faults.armed()}
 
+    # whether speculative decode was ENABLED at boot (post any sp-mesh
+    # stand-down): the knobs endpoint only RESIZES live speculation —
+    # turning it on where the boot config (or a stand-down) left it off
+    # would recreate the exact hazard the stand-down existed to avoid
+    spec_boot_on = continuous is not None and continuous.spec_k >= 2
+
+    def knobs_admin(req: dict) -> dict:
+        """POST /v1/debug/knobs (host-only): live-retune the continuous
+        engine's per-dispatch knobs. The elastic fleet controller's
+        actuator for pipeline_depth (from overlap_ratio/fetch stall)
+        and spec_k (from the live acceptance EWMA). Values are clamped
+        and pow-2-bucketed here so a controller bug can never push the
+        engine outside its compiled program shapes."""
+        if continuous is None:
+            return {"ok": False,
+                    "error": "no continuous engine on this handler "
+                             "(pipeline_depth/spec_k are engine knobs)"}
+        known = {"pipeline_depth", "spec_k"}
+        unknown = sorted(set(req) - known)
+        if unknown or not (set(req) & known):
+            return {"ok": False,
+                    "error": f"want a subset of {sorted(known)}, got "
+                             f"{sorted(req) or 'nothing'}"}
+        if "pipeline_depth" in req:
+            try:
+                d = int(req["pipeline_depth"])
+            except (TypeError, ValueError):
+                return {"ok": False, "error": "pipeline_depth wants an int"}
+            if not 1 <= d <= 8:
+                return {"ok": False,
+                        "error": f"pipeline_depth {d} out of range [1, 8]"}
+            continuous.pipeline_depth = d
+            continuous.pipeline_stats.depth = d
+        if "spec_k" in req:
+            try:
+                k = int(req["spec_k"])
+            except (TypeError, ValueError):
+                return {"ok": False, "error": "spec_k wants an int"}
+            if k != 0 and not spec_boot_on:
+                return {"ok": False,
+                        "error": "spec_k was off at boot (config, or an "
+                                 "sp-mesh stand-down): live retune only "
+                                 "resizes speculation, never enables it"}
+            if k != 0:
+                from lambdipy_tpu.models.llama import _next_bucket
+                k = min(8, max(2, _next_bucket(k, 2)))
+            continuous.spec_k = k
+        return {"ok": True,
+                "pipeline_depth": continuous.pipeline_depth,
+                "spec_k": continuous.spec_k}
+
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a
     # multi-second compile at request time (measured ~14 s for a
@@ -1469,6 +1527,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                         if prefix_store is not None else None),
         debug_invariants_fn=debug_invariants,
         faults_admin_fn=faults_admin,
+        knobs_admin_fn=knobs_admin,
         meta={
             "model": spec["model"], "quant": spec.get("quant"),
             "sharded": mesh is not None,

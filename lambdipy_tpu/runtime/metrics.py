@@ -896,6 +896,60 @@ class RouterStats:
 
 
 @dataclass
+class ControllerStats:
+    """Counters for the elastic fleet control loop
+    (fleet/controller.py) — the ``fleet.controller`` block on the
+    fleet ``/metrics``.
+
+    ``actions`` counts APPLIED actions by kind (promote/demote/spawn/
+    retire/set_knob); ``intents`` counts decisions that were logged but
+    NOT applied — every decision in dry-run mode, plus live decisions
+    whose actuator refused (e.g. a spawn with no spawner wired).
+    ``last_decision`` is the most recent non-empty decision trace
+    (tick time, the signal values that drove it, the rendered
+    actions) so an operator can answer "why did the fleet just
+    resize" from one scrape. ``targets`` echoes the loop's current
+    goal posts (SLO, bands, dry_run) — the knobs the controller is
+    steering TOWARD, as opposed to the per-replica knobs it steers."""
+
+    ticks: int = 0
+    errors: int = 0
+    actions: dict = field(default_factory=dict)   # kind -> applied n
+    intents: dict = field(default_factory=dict)   # kind -> logged-only n
+    last_decision: dict = field(default_factory=dict)
+    targets: dict = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def count(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def record_action(self, kind: str, *, applied: bool) -> None:
+        with self._lock:
+            book = self.actions if applied else self.intents
+            book[str(kind)] = book.get(str(kind), 0) + 1
+
+    def record_decision(self, trace: dict) -> None:
+        with self._lock:
+            self.last_decision = dict(trace)
+
+    def set_targets(self, **targets) -> None:
+        with self._lock:
+            self.targets.update(targets)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "ticks": self.ticks,
+                "errors": self.errors,
+                "actions": dict(sorted(self.actions.items())),
+                "intents": dict(sorted(self.intents.items())),
+                "last_decision": dict(self.last_decision),
+                "targets": dict(sorted(self.targets.items())),
+            }
+
+
+@dataclass
 class PrefixCacheStats:
     """Counters for the automatic cross-request prefix KV cache: a
     request whose prompt longest-prefix-matches the radix tree is a hit

@@ -483,6 +483,9 @@ class BundleServer:
                 if self.path == "/v1/debug/faults":
                     self._debug_faults()
                     return
+                if self.path == "/v1/debug/knobs":
+                    self._debug_knobs()
+                    return
                 if self.path == "/profile":
                     req = self._read_json()
                     if req is None:
@@ -674,7 +677,15 @@ class BundleServer:
                             "type": "invalid_request_error"}})
                         return
                     server_self.stats.record((time.monotonic() - t0) * 1e3)
-                    self._send(200, _internal_to_openai(internal, result))
+                    out = _internal_to_openai(internal, result)
+                    # echo the ACTUAL sched queue wait (stamped on the
+                    # ticket at grant) so a client can window latency
+                    # attribution per-request instead of reading the
+                    # replica's cumulative percentile reservoir
+                    wait_ms = getattr(ticket, "wait_ms", None)
+                    if wait_ms is not None:
+                        out["queue_wait_ms"] = round(wait_ms, 3)
+                    self._send(200, out)
                 finally:
                     self._end_invoke(ticket, t_start)
 
@@ -1007,6 +1018,31 @@ class BundleServer:
                 if fn is None:
                     self._send(404, {"ok": False, "error":
                                      "no fault-control surface "
+                                     "(unsupported handler)"})
+                    return
+                try:
+                    out = fn(request)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"ok": False, "error": str(e)})
+                    return
+                self._send(200 if out.get("ok") else 400, out)
+
+            def _debug_knobs(self):
+                """POST /v1/debug/knobs (host-only): live-retune the
+                continuous engine's per-dispatch knobs (pipeline_depth,
+                spec_k) — the elastic fleet controller's actuator.
+                Same control-plane shape as _debug_faults: loopback
+                refusal first, clamping in the handler closure."""
+                if not self._require_loopback():
+                    return
+                request = self._read_json()
+                if request is None:
+                    return
+                fn = getattr(server_self.boot.state, "knobs_admin_fn",
+                             None)
+                if fn is None:
+                    self._send(404, {"ok": False, "error":
+                                     "no knob-control surface "
                                      "(unsupported handler)"})
                     return
                 try:

@@ -35,6 +35,7 @@ class Ticket:
     enqueued: float = field(default_factory=time.monotonic)
     granted: bool = False
     expired: bool = False          # deadline shed after admission
+    wait_ms: float | None = None   # actual queue wait, stamped at grant
 
 
 class RequestQueue:
