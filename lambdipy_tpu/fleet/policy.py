@@ -78,6 +78,11 @@ class ReplicaView:
     fetch_frac: float | None = None   # fetch_block_s / wall_s
     spec_k: int | None = None
     acceptance: float | None = None   # batching.spec acceptance_rate
+    # draft tier (batching.spec.draft): the engine's current provider
+    # default and the MODEL provider's acceptance EWMA — the signal the
+    # demote rule watches for a collapsed self-draft head
+    draft_mode: str | None = None
+    draft_acceptance: float | None = None
 
 
 @dataclass(frozen=True)
@@ -132,6 +137,12 @@ class PolicyConfig:
     spec_k_max: int = 8
     acceptance_high: float = 0.8
     acceptance_low: float = 0.4
+    # draft_mode: demote the engine DEFAULT model -> lookup when the
+    # model provider's acceptance EWMA collapses below the floor (the
+    # per-row fallback already protects in-flight rows one by one; this
+    # stops NEW rows from re-paying the discovery). Never promoted
+    # lookup -> model here: that is an operator/boot decision.
+    draft_acceptance_floor: float = 0.2
     # ship_window: more frames in flight when the transfer is slow
     # (ship latency EWMA prices the transport), fewer when it is ~free
     ship_window_min: int = 2
@@ -165,7 +176,7 @@ class Action:
     target: str
     role: str | None = None        # spawn/promote/demote: the new class
     knob: str | None = None
-    value: int | float | None = None
+    value: int | float | str | None = None   # str: e.g. draft_mode
     reason: str = ""
 
     def render(self) -> str:
@@ -324,6 +335,17 @@ def _knobs(snap: Snapshot, state: PolicyState,
                 emit(r.name, "spec_k",
                      max(cfg.spec_k_min, _next_pow2(r.spec_k, up=False)),
                      f"acceptance {r.acceptance:.2f}")
+        # draft_mode: demote the engine default model -> lookup when
+        # the self-draft head's acceptance EWMA has collapsed — new
+        # rows stop paying the draft forward at all, instead of each
+        # rediscovering the collapse through its own per-row fallback
+        if r.draft_mode in ("model", "aux") \
+                and r.spec_k is not None and r.spec_k >= 2 \
+                and r.draft_acceptance is not None \
+                and r.draft_acceptance < cfg.draft_acceptance_floor:
+            emit(r.name, "draft_mode", "lookup",
+                 f"draft acceptance {r.draft_acceptance:.2f} < "
+                 f"{cfg.draft_acceptance_floor:.2f}")
     # the router's ship window from the ship-latency EWMA — only once
     # real ships have priced the transport
     if snap.ships > 0 and snap.ship_window > 0:

@@ -461,7 +461,7 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, mask=None, cache=None,
-                 logit_positions=None):
+                 logit_positions=None, exit_layer=None):
         """Returns (logits, new_cache).
 
         prefill: cache=None, tokens [b, s] -> cache entries sized s.
@@ -471,8 +471,16 @@ class LlamaModel(nn.Module):
         row of logits, not s: the full [b, s, vocab] f32 tensor is the
         largest activation of the whole serve path (8B at 8k context:
         4 GB) and s unneeded lm_head matmuls.
+        exit_layer: optional int — a SHALLOW-EXIT forward: run only
+        layers 0..exit_layer-1, then final_norm + the TIED lm_head over
+        that early hidden state (the self-drafting head for the
+        speculative draft tier). Params for the skipped layers are
+        simply never looked up, so the same param tree serves both
+        depths; ``cache`` (when given) holds one entry per RUN layer.
         """
         cfg = self.cfg
+        n_layers = (cfg.layers if exit_layer is None
+                    else max(1, min(int(exit_layer), cfg.layers)))
         b, s = tokens.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
@@ -482,7 +490,7 @@ class LlamaModel(nn.Module):
                        param_dtype=cfg.dtype, name="embed")
         x = emb(tokens)
         new_cache = []
-        for i in range(cfg.layers):
+        for i in range(n_layers):
             layer_cache = None if cache is None else cache[i]
             x, c = LlamaBlock(cfg, name=f"layer_{i}")(x, positions, mask, layer_cache)
             new_cache.append(c)
@@ -1223,6 +1231,42 @@ def _lookup_draft_hit(context, k: int, ngram_max: int = 3) -> tuple:
             out[:cand.size] = cand
             return out.tolist(), True
     return [int(ctx[-1])] * k, False
+
+
+def _shallow_draft(model, params, tok, cache, pos, kb: int,
+                   exit_layer: int):
+    """Self-drafting shallow-exit chain (device-side, traced INSIDE a
+    verify program): run ``kb - 1`` sequential one-token forwards through
+    only the first ``exit_layer`` layers + final_norm + the tied lm_head
+    (:class:`LlamaModel`'s ``exit_layer`` path), each greedy-argmax token
+    feeding the next step — the Medusa/EAGLE-style "cheap head over the
+    target's own early hidden state" draft source, costing roughly
+    ``exit_layer / layers`` of a full forward per proposed token.
+
+    The chain reads/writes a SCRATCH alias of the early layers' windowed
+    KV entries: each functional ``.at[].set`` write lands in throwaway
+    arrays the caller discards, so the real cache the verify chunk runs
+    over is untouched — the draft can never poison verification, and
+    acceptance stays chain-deterministic whatever the drafts are. Because
+    it runs in-program off the device-true carry token, the drafts are
+    never stale at pipeline depth >= 2 (unlike host lookup, which must
+    extrapolate across in-flight steps). Returns ``d_model [b, kb-1]``
+    int32."""
+    dcache = [dict(entry) for entry in cache[:exit_layer]]
+    cur = tok
+    drafts = []
+    for j in range(kb - 1):
+        step_pos = pos + j
+        for entry in dcache:
+            entry["index"] = step_pos
+        lg, dcache = model.apply(params, cur[:, None],
+                                 positions=step_pos[:, None],
+                                 cache=dcache, exit_layer=exit_layer)
+        nxt = jnp.argmax(lg[:, -1, :].astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+        drafts.append(nxt)
+        cur = nxt
+    return jnp.stack(drafts, axis=1)
 
 
 class LlamaServer:
@@ -2092,6 +2136,66 @@ class LlamaServer:
         return self._fn_cached(("spec_seg", b, cache_len, window, kb),
                                build)
 
+    def _mspec_seg_fn(self, b: int, cache_len: int, window: int, kb: int,
+                      exit_layer: int):
+        """MODEL-DRAFT twin of :meth:`_spec_seg_fn`: before the verify
+        chunk, :func:`_shallow_draft` runs ``kb - 1`` shallow-exit
+        (``exit_layer`` layers + tied lm_head) greedy steps in-program
+        off the row's true carry token, then each row takes its model
+        chain or the host-provided draft operand per the ``use_model``
+        mask — the per-row provider seam's device half. Host-masked
+        positions arrive as RAW ``-1`` in ``draft_host`` with
+        ``use_model 0``, so a row drafting fewer than ``kb - 1`` tokens
+        (per-row adaptive k) can never have its padding accepted: the
+        chain compares raw values and every real chain token is in
+        ``[0, vocab)``. Verification is untouched — the same
+        :func:`_spec_chain_verify` walk decides acceptance, so emitted
+        tokens stay bitwise the non-speculative engine's whatever the
+        draft source proposes."""
+        def build():
+            def seg(params, temperature, top_k, top_p, draft_host,
+                    use_model, tok, lp, cache, pos, done, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                win = cache
+                if window < cache_len:
+                    win = [{name: (val if name == "index"
+                                   else jax.lax.slice_in_dim(
+                                       val, 0, window, axis=1))
+                            for name, val in entry.items()}
+                           for entry in cache]
+                d_model = _shallow_draft(self.model, params, tok, win,
+                                         pos, kb, exit_layer)
+                draft = jnp.where(use_model > 0, d_model, draft_host)
+                # clamp-for-embedding / compare-raw, as in _spec_seg_fn
+                chunk = jnp.concatenate(
+                    [tok[:, None],
+                     jnp.clip(draft, 0, self.model.cfg.vocab_size - 1)],
+                    axis=1)
+                positions = pos[:, None] + jnp.arange(kb)[None, :]
+                logits, new_cache = self.model.apply(
+                    params, chunk, positions=positions, cache=win)
+                lg = logits.astype(jnp.float32)        # [b, kb, v]
+                lps_block, count, tok2, lp2, keys2 = _spec_chain_verify(
+                    select, lg, draft, lp, rng)
+                pos2 = pos + count
+                for entry in new_cache:
+                    entry["index"] = pos2
+                merged = new_cache
+                if window < cache_len:
+                    merged = [
+                        {name: (val if name == "index"
+                                else jax.lax.dynamic_update_slice_in_dim(
+                                    cache[i][name], val, 0, axis=1))
+                         for name, val in entry.items()}
+                        for i, entry in enumerate(new_cache)]
+                return ((chunk, lps_block, count, tok2),
+                        (tok2, lp2, merged, pos2, done, keys2))
+
+            return jax.jit(seg)
+
+        return self._fn_cached(
+            ("mspec_seg", b, cache_len, window, kb, exit_layer), build)
+
     # -- paged KV programs (runtime/pagepool.py arena) ------------------------
     #
     # The paged engine's device programs. Each one follows the same
@@ -2170,6 +2274,49 @@ class LlamaServer:
 
         return self._fn_cached(
             ("spec_pseg", b, n_pages, page, window, kb), build)
+
+    def _mspec_pseg_fn(self, b: int, n_pages: int, page: int, window: int,
+                       kb: int, exit_layer: int):
+        """Paged twin of :meth:`_mspec_seg_fn`: gather the rows' pages
+        into the contiguous window, run the in-program shallow-exit
+        draft chain over a SCRATCH alias of that gathered window's early
+        layers (writes land in throwaway gathered arrays — the arena is
+        only ever written by the verify chunk's scatter), then the same
+        per-row provider select + chunk verify, and scatter back. The
+        null-page-0 over-allocation story is unchanged: only the verify
+        chunk's ``new_cache`` reaches :func:`_scatter_page_cache`."""
+        def build():
+            def seg(params, temperature, top_k, top_p, draft_host,
+                    use_model, tok, lp, arena, tables, pos, done, rng,
+                    eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                cache = _gather_page_cache(arena, tables, window, page,
+                                           pos)
+                d_model = _shallow_draft(self.model, params, tok, cache,
+                                         pos, kb, exit_layer)
+                draft = jnp.where(use_model > 0, d_model, draft_host)
+                # clamp-for-embedding / compare-raw, as in _spec_seg_fn
+                chunk = jnp.concatenate(
+                    [tok[:, None],
+                     jnp.clip(draft, 0, self.model.cfg.vocab_size - 1)],
+                    axis=1)
+                positions = pos[:, None] + jnp.arange(kb)[None, :]
+                logits, new_cache = self.model.apply(
+                    params, chunk, positions=positions, cache=cache)
+                lg = logits.astype(jnp.float32)        # [b, kb, v]
+                lps_block, count, tok2, lp2, keys2 = _spec_chain_verify(
+                    select, lg, draft, lp, rng)
+                pos2 = pos + count
+                new_arena = _scatter_page_cache(arena, tables, new_cache,
+                                                page)
+                return ((chunk, lps_block, count, tok2),
+                        (tok2, lp2, new_arena, pos2, done, keys2))
+
+            return jax.jit(seg)
+
+        return self._fn_cached(
+            ("mspec_pseg", b, n_pages, page, window, kb, exit_layer),
+            build)
 
     def _paged_pack_fn(self, gb: int, n_pages: int, page: int, width: int):
         """Pack row ``src`` of a ``gb``-row contiguous prefill carry into

@@ -398,6 +398,60 @@ def _build_llama_tiny(dtype: str = "float32", quant: str | None = None,
     return _build_llama(cfg)
 
 
+def draft_twin(adapter: JaxModel, *, layers: int = 2, hidden: int | None = None,
+               seed: int = 0, params: Any = None, mesh=None, **caps):
+    """Build a small same-family DRAFT server for the aux draft tier.
+
+    Returns a compile-once server (``LlamaServer``) over a shrunken copy
+    of ``adapter``'s config — same vocab (drafts are token ids in the
+    target's vocabulary, so the vocab may never differ), fewer layers,
+    optionally a narrower ``hidden`` (head count scales to preserve the
+    target's head_dim). The twin is TP-REPLICATED: its params carry
+    empty sharding rules, so on a mesh every shard drafts locally and no
+    collective sits on the draft path — the whole point of a draft model
+    is to be too small to be worth sharding.
+
+    ``params=None`` random-inits the twin (tests/benches exercising the
+    seam); a real deployment passes distilled weights. Wrap the returned
+    server in :class:`lambdipy_tpu.runtime.continuous.AuxModelDraft` and
+    hand it to the engine as ``draft_provider`` with
+    ``draft_mode="aux"``. Extra ``caps`` go to the server constructor
+    (e.g. ``prefix_cache_max``).
+    """
+    import dataclasses
+
+    from lambdipy_tpu.parallel.sharding import ShardingRules
+
+    cfg = adapter.config
+    if cfg is None or not hasattr(cfg, "vocab_size"):
+        raise ModelError("draft_twin needs a llama-family adapter "
+                         "(adapter.config must be a LlamaConfig)")
+    overrides: dict[str, Any] = {
+        "layers": max(1, min(int(layers), cfg.layers)),
+        # quant/kv_quant buy nothing at draft scale and int8 random-init
+        # is a pointless extra code path — the twin serves float
+        "quant": None, "kv_quant": None,
+    }
+    if hidden is not None:
+        head_dim = max(1, cfg.hidden // cfg.heads)
+        heads = max(1, int(hidden) // head_dim)
+        overrides.update(
+            hidden=heads * head_dim,
+            heads=heads,
+            kv_heads=max(1, min(cfg.kv_heads, heads)),
+            mlp=2 * heads * head_dim,
+        )
+    twin = _build_llama(dataclasses.replace(cfg, **overrides))
+    twin.tp_rules = ShardingRules(rules=())  # replicate on any mesh
+    if params is None:
+        params = twin.init_params(seed=seed)
+    if mesh is not None:
+        from lambdipy_tpu.parallel.sharding import shard_params
+
+        params = shard_params(params, mesh, twin.tp_rules)
+    return twin.make_server(params, mesh=mesh, **caps)
+
+
 # --------------------------------------------------------------------------
 # non-JAX families (configs 2 and 4 compatibility paths)
 

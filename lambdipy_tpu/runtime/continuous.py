@@ -141,6 +141,45 @@ class _StaleArena(Exception):
     solo path instead of serving wrong KV."""
 
 
+class DraftProvider:
+    """Host-side draft source behind the engine's provider seam
+    (``draft_mode="aux"``): given a row's confirmed context, propose up
+    to ``k`` continuation tokens for the chain verify to score. A
+    provider is ONLY ever a proposal source — acceptance is decided by
+    :func:`lambdipy_tpu.models.llama._spec_chain_verify` against the
+    target's own select walk, so a wrong (or short, padded with ``-1``)
+    proposal costs wasted verify positions, never a wrong token. The
+    in-program shallow-exit head (``draft_mode="model"``) does NOT go
+    through this interface: it drafts on-device inside the verify
+    program, which is what keeps it fresh under pipelining."""
+
+    def propose(self, context, k: int) -> list:
+        raise NotImplementedError
+
+
+class AuxModelDraft(DraftProvider):
+    """A separate small draft model behind :class:`DraftProvider`: any
+    ``generate``-shaped server (e.g. a TP-replicated registry twin built
+    by :func:`lambdipy_tpu.models.registry.draft_twin`) greedily
+    continues the context by ``k`` tokens. Reference implementation for
+    the two-model draft tier — it re-prefills the context every call, so
+    at CPU bench scale the self-drafting shallow-exit head is the one
+    that pays; this seam is what a cached-KV draft server would slot
+    into."""
+
+    def __init__(self, server: Any):
+        self.server = server
+
+    def propose(self, context, k: int) -> list:
+        import numpy as np
+
+        ctx = [int(t) for t in np.asarray(context).reshape(-1)]
+        if not ctx or k <= 0:
+            return []
+        out = self.server.generate(ctx, max_new_tokens=int(k))
+        return [int(t) for t in np.asarray(out).reshape(-1)[:k]]
+
+
 class ContinuousBatcher:
     """Segment-boundary continuous batching over a LlamaServer."""
 
@@ -154,7 +193,9 @@ class ContinuousBatcher:
                  degrade_window_s: float = 60.0,
                  degrade_clean_s: float = 30.0,
                  page_pool: Any = None,
-                 spec_k: int = 0, spec_ngram: int = 3):
+                 spec_k: int = 0, spec_ngram: int = 3,
+                 draft_mode: str = "lookup", draft_exit: int = 1,
+                 draft_provider: Any = None):
         import jax
 
         from lambdipy_tpu.runtime.metrics import (DecodeWindowStats,
@@ -213,6 +254,12 @@ class ContinuousBatcher:
             from lambdipy_tpu.parallel.spdecode import note_standdown
 
             note_standdown("spec_k_under_sp_mesh")
+            if str(draft_mode or "").lower() in ("model", "auto", "aux"):
+                # the draft tier rides the spec verify chunk, so it
+                # stands down with it — counted under its own reason so
+                # a fleet can tell "spec off under sp" from "draft tier
+                # requested but unservable"
+                note_standdown("draft_tier_under_sp_mesh")
             log.warning(
                 "engine spec_k=%d stands down: the mesh's sp axis serves "
                 "decode through sequence-parallel one-token steps, and a "
@@ -221,6 +268,47 @@ class ContinuousBatcher:
                 self.spec_k)
             self.spec_k = 0
         self.spec_ngram = max(1, int(spec_ngram))
+        # -- model draft tier (ROADMAP direction 4) --------------------------
+        # draft_mode picks the engine-default DRAFT PROVIDER for rows
+        # admitted while it holds (live-retunable via /v1/debug/knobs):
+        #   "lookup" — PR 9's host prompt-lookup drafting, fixed k
+        #              (today's exact behavior, still the default);
+        #   "model"  — the self-drafting shallow-exit head
+        #              (models/llama.py _shallow_draft through the
+        #              _mspec_* program families): per-row ADAPTIVE k
+        #              slow-starts at 2, grows on a high acceptance EWMA
+        #              and collapses model -> lookup -> off per row, so
+        #              an adversarial row stops paying the draft forward
+        #              while its neighbors keep speculating;
+        #   "aux"    — a separate small draft model behind the same
+        #              seam: a host-side DraftProvider (draft_provider=,
+        #              e.g. AuxModelDraft over a registry twin) proposes
+        #              the tokens, adaptivity identical to "model";
+        #   "off"    — spec verify stays available but rows draft
+        #              nothing (plain decode until retuned).
+        # Whatever the provider proposes, acceptance is the SAME
+        # chain-deterministic verify — outputs stay bitwise spec-off.
+        dm = str(draft_mode or "lookup").lower()
+        if dm == "auto":
+            dm = "model"
+        if dm not in ("model", "lookup", "aux", "off"):
+            log.warning("unknown draft_mode %r; using lookup", draft_mode)
+            dm = "lookup"
+        self.draft_provider = draft_provider
+        if dm == "aux" and draft_provider is None:
+            log.warning("draft_mode=aux needs draft_provider=; "
+                        "using lookup")
+            dm = "lookup"
+        self.draft_mode = dm
+        layers = int(getattr(cfg, "layers", 1) or 1)
+        self.draft_exit = max(1, min(int(draft_exit or 1), layers))
+        # per-row adaptive-k controller constants: EWMA weight on the
+        # newest step's accepted fraction, and the grow/shrink bands
+        # (hysteresis — the gap keeps k from flapping at a steady
+        # mid-range acceptance)
+        self.spec_ewma_alpha = 0.3
+        self.spec_grow = 0.75
+        self.spec_shrink = 0.35
         # -- tensor-parallel sharded serving (ROADMAP direction 3) -----------
         # a server with a multi-device mesh runs every engine program
         # SPMD: params and the KV carry are tp-sharded, the host-side
@@ -707,7 +795,8 @@ class ContinuousBatcher:
                                          self.cache_len, self.segment)
         return seg
 
-    def _spec_draft(self, entry: dict, kb: int, q: int | None = None):
+    def _spec_draft(self, entry: dict, kb: int, q: int | None = None,
+                    k: int | None = None, provider: str = "lookup"):
         """Host-side prompt-lookup draft for ONE verify step of a live
         row. The draft always EXTRAPOLATES FROM FETCHED TRUTH: the
         confirmed context (prompt — cached prefix included, a shared
@@ -723,27 +812,109 @@ class ContinuousBatcher:
         drafts merely miss (every step still emits >= 1 exact chain
         token — the verify compares against the device's own carry,
         never this guess) and the very next dispatch re-extrapolates
-        from newer truth. Returns ``(d_verify [kb-1], hit)``."""
+        from newer truth.
+
+        ``k`` is the ROW's draft width this step (per-row adaptive k;
+        defaults to the dispatch width ``kb``): the in-flight
+        extrapolation strides by ``k`` because that is the most this
+        row's pending steps can have advanced. ``provider`` routes
+        between prompt lookup and the engine's host-side
+        :class:`DraftProvider` (``"aux"``). Returns
+        ``(d_verify [k-1], hit)``."""
         from lambdipy_tpu.models.llama import _lookup_draft_hit
 
+        k = kb if k is None else max(2, min(int(k), kb))
         base = ((entry.get("prefix_toks") or []) + entry["row"]
                 + entry["toks"])
         if q is None:
             q = entry["spec_inflight"]
         pend = entry.get("spec_pend")
+        if provider == "aux" and self.draft_provider is not None:
+            # the aux draft model extrapolates the same way lookup
+            # does: it proposes across the q assumed-accepted in-flight
+            # steps too, and this step takes its slice. A short or
+            # failing proposal pads RAW -1 — never accepted, so a
+            # misbehaving provider degrades to plain decode, not to a
+            # wrong token.
+            need = (q + 1) * k - (1 if pend is not None else 0)
+            try:
+                ext = [int(t) for t in
+                       self.draft_provider.propose(
+                           base + ([pend] if pend is not None else []),
+                           need)]
+            except Exception:  # noqa: BLE001 — a proposal, not a result
+                ext = []
+            hit = len(ext) >= need
+            ext += [-1] * (need - len(ext))
+            if pend is not None:
+                return ext[q * k: q * k + k - 1], hit
+            return ext[q * k + 1: (q + 1) * k], hit
         if pend is not None:
             # ext[i] predicts chain position len(base) + 1 + i; the new
-            # step's chunk starts q*kb positions past the pending
+            # step's chunk starts q*k positions past the pending
             ext, hit = _lookup_draft_hit(base + [pend],
-                                         (q + 1) * kb - 1,
+                                         (q + 1) * k - 1,
                                          ngram_max=self.spec_ngram)
-            return ext[q * kb: q * kb + kb - 1], hit
+            return ext[q * k: q * k + k - 1], hit
         # the device holds the true pending token but the host has not
         # fetched one yet (freshly packed row): extrapolate from the
         # prompt alone — ext[0] guesses the pending itself
-        ext, hit = _lookup_draft_hit(base, (q + 1) * kb,
+        ext, hit = _lookup_draft_hit(base, (q + 1) * k,
                                      ngram_max=self.spec_ngram)
-        return ext[q * kb + 1: (q + 1) * kb], hit
+        return ext[q * k + 1: (q + 1) * k], hit
+
+    def _spec_row_init(self) -> tuple:
+        """(provider, k_row) a freshly admitted row starts with, from
+        the engine's CURRENT draft_mode (so a live knob retune applies
+        to new rows while in-flight rows keep their adapted state).
+        Legacy lookup mode keeps the fixed-k behavior (k_row pinned at
+        spec_k, no adaptivity); the model/aux tiers SLOW-START at the
+        k=2 minimum bucket — an adversarial row's first steps pay one
+        draft token, not spec_k - 1, which is what keeps its tok/s
+        within noise of spec-off while the EWMA decides."""
+        if not self.spec_k or self.draft_mode == "off":
+            return "off", 1
+        if self.draft_mode == "lookup":
+            return "lookup", self.spec_k
+        return self.draft_mode, 2
+
+    def _spec_adapt(self, entry: dict, provider: str, k_used: int,
+                    accepted_c: int) -> None:
+        """Per-row adaptive k, run by the collector (engine lock held)
+        after each verify step lands: fold the step's accepted fraction
+        into the row's acceptance EWMA, then grow k (pow-2, up to
+        spec_k) while the row stays above the grow band, shrink it
+        below the shrink band, and on collapse AT the k=2 minimum
+        bucket demote the row's provider down the fallback chain
+        model/aux -> lookup -> off (sticky, counted under
+        ``batching.spec.draft.fallbacks``). Inert in legacy lookup
+        mode."""
+        if self.draft_mode in ("lookup", "off"):
+            return
+        if provider == "off" or k_used < 2:
+            return
+        frac = (accepted_c - 1) / float(k_used - 1)
+        ew = entry.get("accept_ewma")
+        a = self.spec_ewma_alpha
+        ew = frac if ew is None else ((1.0 - a) * ew + a * frac)
+        entry["accept_ewma"] = ew
+        if entry["draft_mode"] != provider:
+            # the row was demoted between this step's dispatch and its
+            # collect (depth >= 2): the stale step still feeds the
+            # EWMA above, but must not re-tune k for the new provider
+            return
+        if ew >= self.spec_grow and entry["k_row"] < self.spec_k:
+            entry["k_row"] = min(self.spec_k, max(2, entry["k_row"] * 2))
+        elif ew <= self.spec_shrink:
+            if entry["k_row"] > 2:
+                entry["k_row"] = max(2, entry["k_row"] // 2)
+            else:
+                nxt = "lookup" if provider in ("model", "aux") else "off"
+                entry["draft_mode"] = nxt
+                entry["k_row"] = 2 if nxt != "off" else 1
+                entry["accept_ewma"] = None
+                self.spec_metrics.record_draft_fallback(
+                    f"{provider}->{nxt}")
 
     # -- fault isolation -----------------------------------------------------
 
@@ -1181,9 +1352,9 @@ class ContinuousBatcher:
                     # already sits in garbage positions behind the
                     # device-side index)
                     c = int(counts_h[slot]) if kb_rec else block.shape[1]
-                    hit = rec["assumed"].pop(slot, None) if kb_rec \
+                    info = rec["assumed"].pop(slot, None) if kb_rec \
                         else None
-                    if hit is not None:
+                    if info is not None:
                         # this row's step left the pipeline (the row
                         # may have finished meanwhile — still count it)
                         entry["spec_inflight"] -= 1
@@ -1215,9 +1386,18 @@ class ContinuousBatcher:
                         # most advanced truth).
                         entry["disp"] -= (kb_rec - c)
                         entry["spec_pend"] = int(pending_h[slot])
-                        self.spec_metrics.record_step(
-                            proposed=kb_rec - 1, accepted=c - 1,
-                            emitted=c, hit=bool(hit))
+                        if info is not None:
+                            # per-provider accounting uses the ROW's
+                            # dispatched width (adaptive k snapshot),
+                            # not the batch bucket — a k=2 row in a
+                            # kb=8 dispatch proposed 1 token, and the
+                            # EWMA must see its real accepted fraction
+                            prov, hit, k_used = info
+                            self.spec_metrics.record_step(
+                                proposed=k_used - 1, accepted=c - 1,
+                                emitted=c, hit=bool(hit),
+                                provider=prov, k=k_used)
+                            self._spec_adapt(entry, prov, k_used, c)
                     eos, n = entry["eos_id"], entry["n"]
                     if eos is not None and entry["eos_at"] is None \
                             and eos in row_toks:
@@ -1485,13 +1665,8 @@ class ContinuousBatcher:
                     # device misbehaves) — plain and spec dispatches
                     # interleave freely because both advance the same
                     # carry and emit the same deterministic chain
-                    kb = (self.spec_k
-                          if self.spec_k
-                          and self.fault_stats.degrade_level < 2 else 0)
-                    # optimistic per-dispatch advance: a verify step
-                    # moves a row 1..kb tokens; disp books the maximum
-                    # and the collector refunds the shortfall
-                    adv = kb or self.segment
+                    spec_on = bool(self.spec_k
+                                   and self.fault_stats.degrade_level < 2)
                     with self._lock:
                         if gen != self._gen:
                             raise _StaleEngine()
@@ -1514,6 +1689,29 @@ class ContinuousBatcher:
                             # segments) reaches the packing barrier
                             cause = "joiner"
                             break
+                        # per-dispatch verify width: the pow-2 bucket of
+                        # the live rows' ADAPTIVE k (legacy lookup mode
+                        # pins every row at spec_k, reproducing the
+                        # fixed-width dispatch exactly). When every live
+                        # row's draft tier is off or collapsed, kb = 0
+                        # and this dispatch IS the plain segment program
+                        # — an adversarial batch pays zero speculation
+                        # overhead, the mechanism behind the >= 0.95x
+                        # fallback gate.
+                        kb = 0
+                        if spec_on:
+                            kmax = max((e["k_row"] for _, e in live
+                                        if not e["done"]
+                                        and e["draft_mode"] != "off"),
+                                       default=1)
+                            if kmax >= 2:
+                                kb = min(self.spec_k,
+                                         _next_bucket(int(kmax), 2))
+                        # optimistic per-dispatch advance: a verify step
+                        # moves a row 1..kb tokens; disp books the
+                        # maximum and the collector refunds the
+                        # shortfall
+                        adv = kb or self.segment
                         t_host = np.zeros((self.slots,), np.float32)
                         k_host = np.zeros((self.slots,), np.int32)
                         p_host = np.ones((self.slots,), np.float32)
@@ -1525,8 +1723,17 @@ class ContinuousBatcher:
                         # real (possibly shared) page, where the dense
                         # engine's private cache rows shrugged it off
                         need_lp = False
-                        d_host = (np.zeros((self.slots, kb - 1), np.int32)
-                                  if kb else None)
+                        # masked draft positions stay RAW -1: a chain
+                        # token is always in [0, vocab), so a row
+                        # drafting fewer than kb - 1 tokens (adaptive
+                        # k_row < kb, provider off, empty slot) can
+                        # never have its padding accepted — the
+                        # embedding path clamps a copy, as ever
+                        d_host = (np.full((self.slots, kb - 1), -1,
+                                          np.int32) if kb else None)
+                        m_host = (np.zeros((self.slots, kb - 1),
+                                           np.int32) if kb else None)
+                        use_model = False
                         assumed: dict = {}
                         to_draft: list = []
                         for slot, e in live:
@@ -1549,7 +1756,8 @@ class ContinuousBatcher:
                             positions.append(e["pos0"] + e["disp"])
                             win_pos.append(e["pos0"] + e["disp"])
                             need_lp = need_lp or e["want_lp"]
-                            if kb:
+                            if kb and e["draft_mode"] != "off" \
+                                    and e["k_row"] >= 2:
                                 # snapshot the in-flight depth now;
                                 # the O(context) lookup itself runs
                                 # AFTER the lock drops (below) — only
@@ -1557,18 +1765,34 @@ class ContinuousBatcher:
                                 # state, so the post-lock read is safe,
                                 # and a concurrent failure handler's
                                 # reset is caught by the generation
-                                # check at dispatch
+                                # check at dispatch. The row's provider
+                                # + adaptive width snapshot rides along
+                                # so a mid-flight retune can't skew
+                                # this step's accounting.
                                 to_draft.append(
-                                    (slot, e, e["spec_inflight"]))
+                                    (slot, e, e["spec_inflight"],
+                                     e["draft_mode"],
+                                     min(int(e["k_row"]), kb)))
                                 e["spec_inflight"] += 1
                             e["disp"] += adv
                     # host-side drafting OUTSIDE the lock: the n-gram
                     # scan is O(context) per row, and admit/stream
                     # waiters must not queue behind it
-                    for slot, e, q in to_draft:
-                        dv, hit = self._spec_draft(e, kb, q)
-                        d_host[slot] = dv
-                        assumed[slot] = hit
+                    for slot, e, q, prov, krow in to_draft:
+                        if prov == "model":
+                            # drafted IN-PROGRAM (shallow-exit chain off
+                            # the device-true carry token): nothing to
+                            # extrapolate host-side, just mark which
+                            # positions take the model chain
+                            m_host[slot, :krow - 1] = 1
+                            assumed[slot] = ("model", True, krow)
+                            use_model = True
+                            continue
+                        dv, hit = self._spec_draft(e, kb, q, k=krow,
+                                                   provider=prov)
+                        d_host[slot, :krow - 1] = \
+                            np.asarray(dv, np.int64)[:krow - 1]
+                        assumed[slot] = (prov, hit, krow)
                     # window bucketing: the segment's furthest write
                     # lands at max(pos) + segment - 1, so a pow-2 window
                     # >= max(pos) + segment keeps every live row's
@@ -1595,14 +1819,24 @@ class ContinuousBatcher:
                         # window up to one page keeps the gather width a
                         # whole number of table entries
                         window = max(window, pool.page)
-                        seg = (server._spec_pseg_fn(
-                                   self.slots, pool.n_pages, pool.page,
-                                   window, kb) if kb
-                               else server._paged_seg_fn(
-                                   self.slots, pool.n_pages, pool.page,
-                                   window, self.segment))
+                        if kb and use_model:
+                            seg = server._mspec_pseg_fn(
+                                self.slots, pool.n_pages, pool.page,
+                                window, kb, self.draft_exit)
+                        elif kb:
+                            seg = server._spec_pseg_fn(
+                                self.slots, pool.n_pages, pool.page,
+                                window, kb)
+                        else:
+                            seg = server._paged_seg_fn(
+                                self.slots, pool.n_pages, pool.page,
+                                window, self.segment)
                         tbl_op = jnp.asarray(
                             tbl_host[:, :window // pool.page])
+                    elif kb and use_model:
+                        seg = server._mspec_seg_fn(
+                            self.slots, self.cache_len, window, kb,
+                            self.draft_exit)
                     elif kb:
                         seg = server._spec_seg_fn(
                             self.slots, self.cache_len, window, kb)
@@ -1618,8 +1852,12 @@ class ContinuousBatcher:
                         knob_ops = (jnp.asarray(t_host),
                                     jnp.asarray(k_host),
                                     jnp.asarray(p_host))
-                        draft_ops = ((jnp.asarray(d_host),) if kb
-                                     else ())
+                        draft_ops = ()
+                        if kb and use_model:
+                            draft_ops = (jnp.asarray(d_host),
+                                         jnp.asarray(m_host))
+                        elif kb:
+                            draft_ops = (jnp.asarray(d_host),)
                         if pool is None:
                             with server._mesh_ctx():
                                 return seg(server.params, *knob_ops,
@@ -1756,6 +1994,12 @@ class ContinuousBatcher:
                  "deadline_at": (time.monotonic() + deadline_ms / 1e3
                                  if deadline_ms else None),
                  "cls": current_request_class(), "seq": next(_entry_seq)}
+        # per-row draft-tier state (inert when spec is off): the row's
+        # CURRENT provider along the fallback chain, its adaptive draft
+        # width, and the acceptance EWMA the collector folds each
+        # landed verify step into
+        entry["draft_mode"], entry["k_row"] = self._spec_row_init()
+        entry["accept_ewma"] = None
         if prefix is not None:
             if self.pool is not None:
                 # paged prefix hit: resolve the prefix to SHARED arena
@@ -2012,6 +2256,8 @@ class ContinuousBatcher:
                     "pipeline": self.pipeline_stats.report(),
                     "decode_window": self.window_stats.report(),
                     **({"spec": {"k": self.spec_k,
+                                 "draft_mode": self.draft_mode,
+                                 "draft_exit": self.draft_exit,
                                  **self.spec_metrics.report()}}
                        if self.spec_k else {}),
                     "segments_run": self.segments_run,

@@ -545,6 +545,18 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # serves solo through generate_speculative.
             sk = extra.get("spec_k",
                            _os.environ.get("LAMBDIPY_SPEC_K", "0"))
+            # draft tier for the engine's spec path (ROADMAP direction
+            # 4): draft_mode picks the provider rows start on — lookup
+            # (PR 9 behavior, default), model (self-drafting
+            # shallow-exit head, per-row adaptive k + fallback), off.
+            # draft_exit sets how many layers the shallow-exit draft
+            # runs (clamped to the model's depth). Extra wins over env
+            # (`lambdipy serve --draft-mode/--draft-exit` bridge).
+            dmode = extra.get("draft_mode",
+                              _os.environ.get("LAMBDIPY_DRAFT_MODE",
+                                              "lookup"))
+            dexit = extra.get("draft_exit",
+                              _os.environ.get("LAMBDIPY_DRAFT_EXIT", "1"))
             from lambdipy_tpu.runtime.faults import FaultPlan
 
             # paged KV memory (runtime/pagepool.py, DEFAULT OFF): one
@@ -599,7 +611,9 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 max_replays=int(mr),
                 faults=engine_faults,
                 page_pool=page_pool,
-                spec_k=int(sk or 0))
+                spec_k=int(sk or 0),
+                draft_mode=str(dmode or "lookup"),
+                draft_exit=int(dexit or 1))
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 
@@ -934,7 +948,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             return {"ok": False,
                     "error": "no continuous engine on this handler "
                              "(pipeline_depth/spec_k are engine knobs)"}
-        known = {"pipeline_depth", "spec_k"}
+        known = {"pipeline_depth", "spec_k", "draft_mode"}
         unknown = sorted(set(req) - known)
         if unknown or not (set(req) & known):
             return {"ok": False,
@@ -964,9 +978,34 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 from lambdipy_tpu.models.llama import _next_bucket
                 k = min(8, max(2, _next_bucket(k, 2)))
             continuous.spec_k = k
+        if "draft_mode" in req:
+            dm = str(req["draft_mode"] or "").lower()
+            if dm == "auto":
+                dm = "model"
+            if dm not in ("model", "lookup", "aux", "off"):
+                return {"ok": False,
+                        "error": "draft_mode wants one of "
+                                 "model|lookup|aux|off"}
+            if dm in ("model", "aux") and not spec_boot_on:
+                # same enablement rule as spec_k: retune only steers a
+                # tier that booted on — it never turns speculation on
+                # where boot config (or a stand-down) left it off
+                return {"ok": False,
+                        "error": "spec was off at boot: draft_mode "
+                                 "retune steers a live draft tier, "
+                                 "never enables one"}
+            if dm == "aux" and continuous.draft_provider is None:
+                return {"ok": False,
+                        "error": "draft_mode=aux needs a draft_provider "
+                                 "wired at boot"}
+            # applies to rows admitted from here on; in-flight rows
+            # keep their adapted per-row provider (the fallback chain
+            # still demotes them individually)
+            continuous.draft_mode = dm
         return {"ok": True,
                 "pipeline_depth": continuous.pipeline_depth,
-                "spec_k": continuous.spec_k}
+                "spec_k": continuous.spec_k,
+                "draft_mode": continuous.draft_mode}
 
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a

@@ -399,10 +399,20 @@ class SpecDecodeStats:
     fallback_rows: int = 0
     row_fallbacks: dict = field(default_factory=dict)  # reason -> rows
     hist: dict = field(default_factory=dict)           # emitted -> steps
+    # -- draft tier (batching.spec.draft) -- per-PROVIDER step counters +
+    # acceptance EWMA (model / lookup / aux), the dispatched-k histogram
+    # (adaptive-k convergence is readable straight off it: mass at the
+    # cap means rows grew, mass at 2 means they collapsed), and the
+    # per-row provider-demotion counts ("model->lookup", "lookup->off")
+    providers: dict = field(default_factory=dict)      # name -> counters
+    k_hist: dict = field(default_factory=dict)         # k -> steps
+    draft_fallbacks: dict = field(default_factory=dict)  # edge -> rows
+    draft_ewma_alpha: float = 0.2
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_step(self, *, proposed: int, accepted: int, emitted: int,
-                    hit: bool) -> None:
+                    hit: bool, provider: str = "lookup",
+                    k: int | None = None) -> None:
         with self._lock:
             self.steps += 1
             self.proposed_tokens += int(proposed)
@@ -414,12 +424,33 @@ class SpecDecodeStats:
             else:
                 self.draft_misses += 1
             self.hist[int(emitted)] = self.hist.get(int(emitted), 0) + 1
+            p = self.providers.setdefault(
+                str(provider), {"steps": 0, "proposed": 0, "accepted": 0,
+                                "ewma": None})
+            p["steps"] += 1
+            p["proposed"] += int(proposed)
+            p["accepted"] += int(accepted)
+            if proposed > 0:
+                frac = int(accepted) / float(proposed)
+                a = self.draft_ewma_alpha
+                p["ewma"] = (frac if p["ewma"] is None
+                             else (1.0 - a) * p["ewma"] + a * frac)
+            if k is not None:
+                self.k_hist[int(k)] = self.k_hist.get(int(k), 0) + 1
 
     def record_fallback(self, reason: str = "plain") -> None:
         with self._lock:
             self.fallback_rows += 1
             self.row_fallbacks[str(reason)] = \
                 self.row_fallbacks.get(str(reason), 0) + 1
+
+    def record_draft_fallback(self, edge: str) -> None:
+        """One row demoted along the provider chain (edge like
+        ``"model->lookup"``) by the engine's per-row adaptive-k
+        controller."""
+        with self._lock:
+            self.draft_fallbacks[str(edge)] = \
+                self.draft_fallbacks.get(str(edge), 0) + 1
 
     def report(self) -> dict:
         try:
@@ -448,6 +479,23 @@ class SpecDecodeStats:
                 "row_fallbacks": dict(self.row_fallbacks),
                 "tokens_per_step_hist": {str(n): c for n, c in
                                          sorted(self.hist.items())},
+                # the draft-tier block the fleet controller reads:
+                # per-provider acceptance EWMA (policy demotes
+                # draft_mode when the model provider's collapses), the
+                # adaptive-k histogram, and provider-demotion counts
+                "draft": {
+                    "providers": {
+                        name: {"steps": p["steps"],
+                               "proposed": p["proposed"],
+                               "accepted": p["accepted"],
+                               "acceptance_ewma": (
+                                   round(p["ewma"], 4)
+                                   if p["ewma"] is not None else None)}
+                        for name, p in sorted(self.providers.items())},
+                    "k_hist": {str(n): c for n, c in
+                               sorted(self.k_hist.items())},
+                    "fallbacks": dict(self.draft_fallbacks),
+                },
                 "sp_standdown": standdowns,
                 # keyed by reason so a fleet can tell "blocked backend
                 # under an sp mesh" from "spec chunk under ring" at the

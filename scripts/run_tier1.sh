@@ -339,4 +339,25 @@ if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 15"
+
+# Phase 16: model-draft speculative tier — bench.py --spec-draft (2
+# forced CPU host devices for its mesh leg) exits nonzero if any
+# draft-on engine output diverges bitwise from the plain path (greedy +
+# seeded-sampled, streamed, concurrent, dense + paged + tp=2 mesh,
+# plus an aux DraftProvider leg), if the shallow-exit drafting engine
+# fails to beat spec-off by >1.5x tok/s on a NON-repetitive workload
+# (prompts selected so prompt-lookup pays nothing — the traffic the
+# PR-9 lookup tier cannot speed up), if the per-row adaptive k fails
+# to converge from its k=2 slow-start to the full bucket on easy rows
+# (acceptance-EWMA and k-histogram gates), or if adversarial
+# high-temperature rows fail to demote model->lookup->off and hold
+# >= 0.95x spec-off wall-clock (the never-pay-the-draft-forward
+# guarantee). Draft counters ride /metrics under batching.spec.draft.
+phase_begin "phase 16: model-draft spec tier (bench.py --spec-draft)"
+if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python bench.py --spec-draft; then
+    echo "FATAL: bench.py --spec-draft sweep failed" >&2
+    exit 1
+fi
+phase_end "phase 16"
 exit 0

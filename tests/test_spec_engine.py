@@ -171,11 +171,11 @@ def test_spec_engine_replay_after_failure(tiny_server, monkeypatch):
     real = cb._spec_draft
     state = {"n": 0}
 
-    def flaky(entry, kb, q=None):
+    def flaky(entry, kb, q=None, **kw):
         state["n"] += 1
         if state["n"] == 2:
             raise RuntimeError("injected draft-time failure")
-        return real(entry, kb, q)
+        return real(entry, kb, q, **kw)
 
     monkeypatch.setattr(cb, "_spec_draft", flaky)
     out = cb.generate([5, 6, 7, 8], max_new_tokens=12, temperature=0.8,
