@@ -2162,6 +2162,160 @@ def decode_window_record(*, lens=(16, 48, 200), cache_len: int = 256,
     }
 
 
+def long_context_record(*, multipliers=(8, 16, 32), cache_len: int = 128,
+                        block: int = 16, n_new: int = 32,
+                        segment: int = 8, stall_frac_gate: float = 0.10,
+                        toks_smooth_gate: float = 4.0,
+                        ttft_slack: float = 3.0,
+                        extra: dict | None = None) -> dict:
+    """Long-context capacity sweep (CPU-runnable): one FIXED page
+    budget — a single compiled window plus two slack pages — serves
+    logical contexts at ``multipliers`` x the compiled window through
+    the sliding-window runner with host offload, and the gate holds the
+    tier to the serve-path bar:
+
+    1. NO SHEDS — every context up to the largest multiplier completes
+       inside the fixed arena; the pool's ``sheds`` counter stays zero
+       (capacity comes from the host tier, not from refusing work).
+    2. PARITY — a context that fits the compiled window decodes BITWISE
+       the dense solo server (base=0 collapses the windowed programs
+       onto the plain paged twin), and the longest sweep point repeats
+       deterministically.
+    3. SMOOTH DEGRADATION — decode tok/s at each multiplier stays
+       within ``toks_smooth_gate`` x of the previous point (no cliff as
+       the offloaded fraction grows), and TTFT grows no worse than
+       ``ttft_slack`` x proportionally to context (prefill is O(ctx);
+       a superlinear blowup means the slide or spill path regressed).
+    4. BOUNDED STALLS — with ``resident_cap`` forcing real churn, the
+       decode-cursor prefetch keeps the re-online stall fraction
+       (``stall_s`` / decode wall) <= ``stall_frac_gate`` and the leaf
+       template is encoded exactly ONCE for the whole sweep.
+    """
+    import numpy as np
+
+    import jax
+
+    from lambdipy_tpu.models import registry
+    from lambdipy_tpu.models.llama import init_page_arena, page_kv_bytes
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+    from lambdipy_tpu.runtime.pagepool import PagePool, page_width
+
+    dims = {"vocab_size": 2048, "hidden": 128, "layers": 2, "heads": 4,
+            "kv_heads": 2, "mlp": 256, "max_len": cache_len}
+    dims.update(extra or {})
+    adapter = registry.get("llama3-8b").build(dtype="float32", extra=dims)
+    cfg = adapter.config
+    params = jax.device_put(adapter.init_params(seed=0))
+    server = adapter.make_server(params)
+
+    multipliers = sorted(int(m) for m in multipliers)
+    page = page_width(cfg.max_len, block)
+    # the FIXED budget: one compiled window of pages + 2 slack (NULL
+    # page rides extra) — the 32x context must fit in exactly this
+    n_pages = cfg.max_len // page + 1 + 2
+    pool = PagePool(n_pages=n_pages, page=page,
+                    page_bytes=page_kv_bytes(cfg, page),
+                    make_arena=lambda: init_page_arena(cfg, n_pages,
+                                                       page))
+    runner = LongContextRunner(
+        server, pool, segment=segment,
+        max_logical_ctx=(multipliers[-1] + 1) * cfg.max_len)
+    # churn: cap residency below the view so the slide really spills
+    # and the prefetch path carries the sweep
+    churn = LongContextRunner(
+        server, pool, segment=segment,
+        max_logical_ctx=(multipliers[-1] + 1) * cfg.max_len,
+        resident_cap=runner.n_view - 1)
+
+    rng = np.random.default_rng(0)
+
+    # parity leg: a within-window row through the runner is bitwise the
+    # dense solo path
+    short = rng.integers(1, cfg.vocab_size, cfg.max_len // 2).tolist()
+    if not np.array_equal(runner.generate(short, max_new_tokens=n_new),
+                          server.generate(short, max_new_tokens=n_new)):
+        raise AssertionError(
+            "long-context parity broke: within-window runner tokens != "
+            "dense solo tokens")
+
+    rows_rec, ttfts, toks = [], [], []
+    for mult in multipliers:
+        row = rng.integers(1, cfg.vocab_size,
+                           mult * cfg.max_len).tolist()
+        # warm pass first: the slide/offload programs compile on their
+        # first use at each shape and would otherwise be billed to TTFT
+        churn.generate(row, max_new_tokens=1)
+        t0 = time.monotonic()
+        churn.generate(row, max_new_tokens=1)
+        ttft = time.monotonic() - t0
+        t0 = time.monotonic()
+        out = churn.generate(row, max_new_tokens=n_new)
+        wall = time.monotonic() - t0
+        decode_s = max(1e-6, wall - ttft)
+        tok_s = n_new / decode_s
+        if mult == multipliers[-1]:
+            out2 = churn.generate(row, max_new_tokens=n_new)
+            if not np.array_equal(out, out2):
+                raise AssertionError(
+                    f"{mult}x context not deterministic across runs")
+        rows_rec.append({"multiplier": mult,
+                         "logical_ctx": mult * cfg.max_len,
+                         "ttft_s": round(ttft, 4),
+                         "tok_s": round(tok_s, 2)})
+        ttfts.append(ttft)
+        toks.append(tok_s)
+
+    pstats = pool.stats()
+    if pstats["sheds"] != 0:
+        raise AssertionError(
+            f"long-context sweep shed work: sheds={pstats['sheds']} — "
+            "the fixed budget must serve every context via offload")
+    if pool.free_count() != pool.capacity_pages:
+        raise AssertionError("page leak across the sweep")
+    for (a, b), (ma, mb) in zip(zip(toks, toks[1:]),
+                                zip(multipliers, multipliers[1:])):
+        if b < a / toks_smooth_gate:
+            raise AssertionError(
+                f"tok/s cliff {ma}x->{mb}x: {a:.1f} -> {b:.1f} "
+                f"(gate {toks_smooth_gate}x)")
+        if ttfts[multipliers.index(mb)] > (
+                ttfts[multipliers.index(ma)] * (mb / ma) * ttft_slack):
+            raise AssertionError(
+                f"TTFT superlinear {ma}x->{mb}x: "
+                f"{ttfts[multipliers.index(ma)]:.3f}s -> "
+                f"{ttfts[multipliers.index(mb)]:.3f}s")
+    rep = churn.report()
+    decode_wall = sum(n_new / t for t in toks)
+    stall_frac = rep["stall_s"] / max(decode_wall, 1e-9)
+    if stall_frac > stall_frac_gate:
+        raise AssertionError(
+            f"re-online stall fraction {stall_frac:.3f} exceeds gate "
+            f"{stall_frac_gate} (stall_s={rep['stall_s']})")
+    if rep["template_encodes"] != 1:
+        raise AssertionError(
+            f"hot loop re-encoded the leaf template: "
+            f"template_encodes={rep['template_encodes']}")
+    if rep["spill_pages"] <= 0:
+        raise AssertionError("sweep never offloaded a page — the churn "
+                             "leg is not exercising the host tier")
+    return {
+        "mode": "long_context",
+        "platform": jax.devices()[0].platform,
+        "compiled_window": cfg.max_len,
+        "page_budget": n_pages,
+        "n_new": n_new,
+        "segment": segment,
+        "parity": True,
+        "sheds": pstats["sheds"],
+        "stall_fraction": round(stall_frac, 4),
+        "prefetch_hit_rate": rep["prefetch_hit_rate"],
+        "spill_pages": rep["spill_pages"],
+        "reonline_pages": rep["reonline_pages"],
+        "template_encodes": rep["template_encodes"],
+        "rows": rows_rec,
+    }
+
+
 def pipeline_record(*, depths=(1, 2), rtts_ms=(0.0, 20.0, 66.0),
                     n_requests: int = 2, prompt_len: int = 12,
                     n_new: int = 64, segment: int = 16, slots: int = 4,
@@ -4078,6 +4232,32 @@ def _decode_window_main() -> int:
     return 0
 
 
+def _long_context_main() -> int:
+    import argparse
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--long-context", action="store_true")
+    ap.add_argument("--multipliers", type=str, default="8,16,32")
+    ap.add_argument("--cache-len", type=int, default=128)
+    ap.add_argument("--block", type=int, default=16)
+    ap.add_argument("--n-new", type=int, default=32)
+    ap.add_argument("--segment", type=int, default=8)
+    ap.add_argument("--stall-frac-gate", type=float, default=0.10)
+    ap.add_argument("--toks-smooth-gate", type=float, default=4.0)
+    ap.add_argument("--ttft-slack", type=float, default=3.0)
+    args = ap.parse_args()
+    _enable_compile_cache()
+    print(json.dumps(long_context_record(
+        multipliers=tuple(int(x) for x in args.multipliers.split(",")),
+        cache_len=args.cache_len, block=args.block, n_new=args.n_new,
+        segment=args.segment, stall_frac_gate=args.stall_frac_gate,
+        toks_smooth_gate=args.toks_smooth_gate,
+        ttft_slack=args.ttft_slack)))
+    return 0
+
+
 def _fleet_main() -> int:
     import argparse
 
@@ -4194,6 +4374,13 @@ def main() -> int:
         # CPU-runnable decode-window sweep: parity + monotone KV-read
         # savings from the length-aware windowed decode path
         return _decode_window_main()
+    if "--long-context" in sys.argv:
+        # CPU-runnable long-context capacity gate: one fixed page
+        # budget serves 8x/16x/32x the compiled window via the sliding
+        # logical window + host offload — zero sheds, within-window
+        # bitwise parity, smooth TTFT/tok-s degradation, re-online
+        # stall fraction bounded with the decode-cursor prefetch live
+        return _long_context_main()
     if "--pipeline" in sys.argv:
         # CPU-runnable pipelined-engine sweep: bitwise parity across
         # pipeline depths + depth-2 tok/s beating depth-1 under a

@@ -498,6 +498,32 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "prefix block); default sizes it to the same HBM the "
                    "dense engine would allocate: batch_max x window "
                    "pages + the reserved null page")
+@click.option("--max-logical-ctx", type=int, default=None,
+              help="long-context tier: serve prompts up to this many "
+                   "LOGICAL tokens over the compiled window by sliding "
+                   "a windowed block table — evicted KV pages spill to "
+                   "a host offload arena and re-online on demand, so a "
+                   "128k-token session runs over a 4k compiled window. "
+                   "Needs --kv-paged; 0 disables (default: bundle "
+                   "max_logical_ctx, else off). Gauges ride /metrics "
+                   "under batching.page_pool.kv_offload")
+@click.option("--kv-offload/--no-kv-offload", default=None,
+              help="host offload tier for the prefix store's paged KV: "
+                   "cache-pressure sweeps SPILL cold pages to host RAM "
+                   "(kvwire frames) instead of dropping them, and a "
+                   "later hit re-onlines the pages in one batched frame "
+                   "decode instead of re-prefilling. Failed re-onlines "
+                   "degrade to a counted prefill recompute — never a "
+                   "wrong token (default: bundle kv_offload, else off)")
+@click.option("--kv-offload-mb", type=float, default=None,
+              help="host RAM budget of the KV offload arena in MiB "
+                   "(default 256); a spill past it falls back to "
+                   "dropping the page, counted as a spill refusal")
+@click.option("--long-prefill/--no-long-prefill", default=None,
+              help="opt the long-context tier's prefill into the "
+                   "ring-attention path (parallel/ring.py) when the "
+                   "mesh has an sp axis; without one the knob stands "
+                   "down counted, never silently")
 @click.option("--spec-k", type=int, default=None,
               help="speculative decoding inside the continuous engine: "
                    "each segment drafts up to K-1 tokens per row by "
@@ -535,7 +561,8 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               sched_queue_cap, sched_rate, sched_burst, prefix_cache_mb,
               prefix_block, session_pin_budget, session_ttl,
               pipeline_depth, engine_watchdog, kv_paged,
-              kv_pages, spec_k, draft_mode, draft_exit, mesh_spec):
+              kv_pages, max_logical_ctx, kv_offload, kv_offload_mb,
+              long_prefill, spec_k, draft_mode, draft_exit, mesh_spec):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
@@ -559,6 +586,14 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_KV_PAGED"] = "1" if kv_paged else "0"
     if kv_pages is not None:
         os.environ["LAMBDIPY_KV_PAGES"] = str(kv_pages)
+    if max_logical_ctx is not None:
+        os.environ["LAMBDIPY_MAX_LOGICAL_CTX"] = str(max_logical_ctx)
+    if kv_offload is not None:
+        os.environ["LAMBDIPY_KV_OFFLOAD"] = "1" if kv_offload else "0"
+    if kv_offload_mb is not None:
+        os.environ["LAMBDIPY_KV_OFFLOAD_MB"] = str(kv_offload_mb)
+    if long_prefill is not None:
+        os.environ["LAMBDIPY_LONG_PREFILL"] = "1" if long_prefill else "0"
     if spec_k is not None:
         os.environ["LAMBDIPY_SPEC_K"] = str(spec_k)
     if draft_mode is not None:

@@ -931,7 +931,7 @@ def _split_rows(keys):
 
 def _scan_decode(model: LlamaModel, params, select_fn, first, lp0, cache,
                  start, done0, keys, eos_id, decode_steps: int,
-                 return_carry: bool = False):
+                 return_carry: bool = False, pos_offset=None):
     """The decode scan shared by the exact-shape path (:func:`_decode`),
     the bucketed serving path (:func:`_serve_decode`) and the streaming
     segment path: one compiled step per token over a static-shape cache.
@@ -943,14 +943,23 @@ def _scan_decode(model: LlamaModel, params, select_fn, first, lp0, cache,
     logsumexp per step, noise next to the forward); filler tokens after
     eos carry logprob 0. ``return_carry`` additionally returns the final
     (tok, lp, cache, pos, done, keys) carry so a later segment can
-    continue the decode exactly where this one stopped."""
+    continue the decode exactly where this one stopped.
+
+    ``pos_offset`` (int32 scalar or ``[b]``, default None) splits the
+    LOGICAL position from the cache-local one: the carry's ``pos`` stays
+    the LOCAL frame (cache writes and the validity mask key off it — the
+    windowed long-context path gathers a sliding view whose slot 0 is
+    logical token ``pos_offset``), while RoPE sees ``pos + pos_offset``,
+    the token's true logical position. None keeps every existing path
+    byte-identical (no extra operand is traced)."""
     b = first.shape[0]
     has_eos = eos_id >= 0
 
     def step(carry, _):
         tok, lp, cache, pos, done, keys = carry  # pos: int32 scalar or [b]
-        positions = (pos[:, None] if jnp.ndim(pos)
-                     else jnp.broadcast_to(pos[None, None], (b, 1)))
+        rope_pos = pos if pos_offset is None else pos + pos_offset
+        positions = (rope_pos[:, None] if jnp.ndim(rope_pos)
+                     else jnp.broadcast_to(rope_pos[None, None], (b, 1)))
         logits, new_cache = model.apply(params, tok[:, None],
                                         positions=positions, cache=cache)
         for entry in new_cache:
@@ -1063,16 +1072,19 @@ def _serve_prefill(model: LlamaModel, params, prompt, length, select, rng,
 
 
 def _continue_prefill(model: LlamaModel, params, cache, suffix, suffix_len,
-                      select, rng, eos_id, sbs: int):
+                      select, rng, eos_id, sbs: int, pos_offset=None):
     """Continuation prefill from a cached prefix KV: embed the suffix
     chunk at positions after the cache index, select the first token, and
     return the decode carry ``(first, lp0, cache, pos, done, rng)``. The
     SINGLE source of the prefix-continuation math — the fused prefix path
     feeds this carry straight into :func:`_scan_decode`, the streaming
     prefix path returns it to segment programs, and their bitwise parity
-    rests on this being one function."""
+    rests on this being one function. ``pos_offset`` is the windowed
+    long-context split (see :func:`_scan_decode`): cache writes stay in
+    the LOCAL frame (``index``), RoPE sees the logical position."""
     idx = cache[0]["index"]
-    positions = (idx + jnp.arange(sbs))[None, :]
+    rope0 = idx if pos_offset is None else idx + pos_offset
+    positions = (rope0 + jnp.arange(sbs))[None, :]
     logits, new_cache = model.apply(
         params, suffix, positions=positions, cache=cache,
         logit_positions=jnp.broadcast_to(suffix_len - 1, (1,)))
@@ -2379,6 +2391,67 @@ class LlamaServer:
             return jax.jit(cont)
 
         return self._fn_cached(("pcont", sbs, n_pages, page, window), build)
+
+    def _lpaged_seg_fn(self, b: int, n_pages: int, page: int, window: int,
+                       segment: int):
+        """LOGICAL-window twin of :meth:`_paged_seg_fn` (the long-context
+        tier, runtime/longctx.py): the block table maps a SLIDING view of
+        a context far larger than the compiled ``window`` — slot 0 of the
+        gathered cache is logical token ``base`` — so the carry's ``pos``
+        is the LOCAL frame (cache writes, validity mask) while RoPE sees
+        ``pos + base``, the token's logical position. With ``base = 0``
+        this computes exactly what :meth:`_paged_seg_fn` computes (int32
+        ``+ 0`` is exact); the host slides ``base`` by whole pages
+        between segments, spilling evicted pages to the offload arena."""
+        def build():
+            def seg(params, temperature, top_k, top_p, first, lp, arena,
+                    tables, local, base, done, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                cache = _gather_page_cache(arena, tables, window, page,
+                                           local)
+                (toks, lps), carry = _scan_decode(
+                    self.model, params, select, first, lp, cache, local,
+                    done, rng, eos_id, segment, return_carry=True,
+                    pos_offset=base)
+                f2, lp2, wcache, local2, done2, rng2 = carry
+                new_arena = _scatter_page_cache(arena, tables, wcache,
+                                                page)
+                return (toks, lps), (f2, lp2, new_arena, local2, done2,
+                                     rng2)
+
+            return jax.jit(seg)
+
+        return self._fn_cached(("lpseg", b, n_pages, page, window, segment),
+                               build)
+
+    def _lpaged_continue_fn(self, sbs: int, n_pages: int, page: int,
+                            window: int):
+        """LOGICAL-window twin of :meth:`_paged_continue_fn`: continue a
+        windowed prefill from the view's filled head — the gathered
+        window holds logical tokens ``[base, base + local)``, the suffix
+        chunk lands at local positions ``[local, local + suffix_len)``
+        with RoPE at their LOGICAL positions. Chained over chunks (the
+        host sliding ``base`` between them) this is the long-context
+        prefill schedule; with ``base = 0`` and one chunk it computes
+        exactly the paged continuation."""
+        def build():
+            def cont(params, arena, table, local, base, suffix,
+                     suffix_len, temperature, top_k, top_p, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                cache = _gather_page_cache(arena, table, window, page,
+                                           local)
+                first, lp0, new_cache, start, done0, keys = \
+                    _continue_prefill(self.model, params, cache, suffix,
+                                      suffix_len, select, rng, eos_id,
+                                      sbs, pos_offset=base)
+                new_arena = _scatter_page_cache(arena, table, new_cache,
+                                                page)
+                return first, lp0, new_arena, start, done0, keys
+
+            return jax.jit(cont)
+
+        return self._fn_cached(("lpcont", sbs, n_pages, page, window),
+                               build)
 
     def _paged_gather_fn(self, n_pages: int, page: int, window: int):
         """Read-only page gather -> contiguous single-row cache (index

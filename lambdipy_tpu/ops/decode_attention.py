@@ -78,6 +78,35 @@ def decode_attention_reference(q, k, v, active_len, *, scale=None):
     return out.reshape(b, s, h, d)
 
 
+def windowed_decode_attention_reference(q, k, v, base, local_len, window,
+                                        *, scale=None):
+    """LOGICAL-window decode attention over a dense big-window cache —
+    the long-context tier's parity oracle.
+
+    k/v hold the FULL logical context ``[b, T, kvh, d]`` (T >= window);
+    row r's attention runs over the sliding view ``[base[r], base[r] +
+    window)`` with ``local_len[r]`` positions valid inside it — exactly
+    the view the block table maps for the windowed paged path
+    (``models/llama.py _lpaged_seg_fn``). Implemented as slice-then-
+    :func:`decode_attention_reference`: the sliced computation has
+    IDENTICAL shapes and operations to what the gathered-window path
+    computes on the same values, so their outputs are bitwise equal by
+    the same shape-identity argument the paged reference rests on. (A
+    mask-over-full-T formulation is mathematically equal but reduces
+    over a different tree — allclose, not bitwise — so the SLICE is the
+    oracle.)"""
+    b = q.shape[0]
+    base = jnp.broadcast_to(jnp.asarray(base, jnp.int32), (b,))
+    k_win = jax.vmap(
+        lambda kk, b0: jax.lax.dynamic_slice_in_dim(kk, b0, window, 0)
+    )(k, base)
+    v_win = jax.vmap(
+        lambda vv, b0: jax.lax.dynamic_slice_in_dim(vv, b0, window, 0)
+    )(v, base)
+    return decode_attention_reference(q, k_win, v_win, local_len,
+                                      scale=scale)
+
+
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
                    acc_ref, *, block_k: int, scale: float, quant: bool,
                    ks_ref=None, vs_ref=None):

@@ -195,7 +195,9 @@ class ContinuousBatcher:
                  page_pool: Any = None,
                  spec_k: int = 0, spec_ngram: int = 3,
                  draft_mode: str = "lookup", draft_exit: int = 1,
-                 draft_provider: Any = None):
+                 draft_provider: Any = None,
+                 max_logical_ctx: int = 0,
+                 long_prefill: bool = False):
         import jax
 
         from lambdipy_tpu.runtime.metrics import (DecodeWindowStats,
@@ -385,6 +387,25 @@ class ContinuousBatcher:
                     f"cache_len {self.cache_len}")
             self.pool.window_pages = self.cache_len // self.pool.page
         self._pack5_fn = None  # scalar-leaf pack for paged prefix carries
+        # -- long-context tier (runtime/longctx.py) --------------------------
+        # max_logical_ctx > cache_len routes a request whose prompt +
+        # budget exceeds the engine cache — today's solo-fallback seam,
+        # where the solo path would REJECT it — to a LongContextRunner:
+        # a sliding logical window over the compiled one, evicted pages
+        # spilled to a host offload arena and re-onlined under the
+        # decode's device time. 0 disables (the exact prior behavior).
+        # Needs a page pool (the runner rides the shared arena); without
+        # one the knob stands down loudly at construction, not at the
+        # first routed request.
+        self.max_logical_ctx = max(0, int(max_logical_ctx or 0))
+        self.long_prefill = bool(long_prefill)
+        self._longctx: Any = None     # built lazily on first routed row
+        self._longctx_lock = threading.Lock()
+        if self.max_logical_ctx and page_pool is None:
+            log.warning(
+                "max_logical_ctx=%d needs paged KV (--kv-paged); the "
+                "long-context tier stands down", self.max_logical_ctx)
+            self.max_logical_ctx = 0
         # -- fault isolation -------------------------------------------------
         # watchdog_s bounds every device-side wait the ENGINE thread
         # makes (dispatch, per-segment fetch, group prefill) plus the
@@ -2119,6 +2140,54 @@ class ContinuousBatcher:
                                  name="continuous-batch").start()
         return entry
 
+    def _longctx_runner(self):
+        """The lazily built long-context tier (one per engine — it
+        serializes its own runs). A construction failure stands the
+        knob down permanently and loudly; it never takes the serve
+        path with it."""
+        if self.pool is None or not self.max_logical_ctx:
+            return None
+        with self._longctx_lock:
+            if self._longctx is None:
+                from lambdipy_tpu.runtime.longctx import LongContextRunner
+
+                try:
+                    self._longctx = LongContextRunner(
+                        self.server, self.pool,
+                        window=self.cache_len,
+                        segment=self.segment,
+                        max_logical_ctx=self.max_logical_ctx,
+                        long_prefill=self.long_prefill,
+                        faults=self.faults,
+                        max_replays=max(1, self.max_replays))
+                except Exception as e:  # noqa: BLE001 — stand down, keep serving
+                    log.error("long-context runner unavailable (knob "
+                              "stands down): %s", e)
+                    self.max_logical_ctx = 0
+                    return None
+            return self._longctx
+
+    def _route_longctx(self, prompt_row, max_new_tokens: int, prefix):
+        """Route an engine-refused request to the long-context tier —
+        only when the refusal was the WINDOW (prompt + budget past
+        cache_len, which the solo fallback would reject outright) and
+        the logical cap holds it. Everything else keeps its existing
+        fallback."""
+        import numpy as np
+
+        if prefix is not None or not self.max_logical_ctx:
+            return None
+        try:
+            s = int(np.asarray(prompt_row).reshape(-1).shape[0])
+        except Exception:  # noqa: BLE001 — malformed rows fail where they did
+            return None
+        if s + int(max_new_tokens) <= self.cache_len:
+            return None
+        runner = self._longctx_runner()
+        if runner is None or not runner.fits(s, int(max_new_tokens)):
+            return None
+        return runner
+
     def generate(self, prompt_row, *, max_new_tokens: int,
                  temperature: float = 0.0, top_k=None, top_p=None,
                  seed: int = 0, eos_id=None, prefix=None,
@@ -2134,6 +2203,13 @@ class ContinuousBatcher:
         entry = self._admit(prompt_row, max_new_tokens, temperature, top_k,
                             top_p, seed, eos_id, return_logprobs, prefix)
         if entry is None:
+            runner = self._route_longctx(prompt_row, max_new_tokens, prefix)
+            if runner is not None:
+                return runner.generate(
+                    prompt_row, max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    seed=seed, eos_id=eos_id,
+                    return_logprobs=return_logprobs)
             return self.server.generate(
                 prompt_row, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
@@ -2181,6 +2257,28 @@ class ContinuousBatcher:
         entry = self._admit(prompt_row, max_new_tokens, temperature, top_k,
                             top_p, seed, eos_id, return_logprobs, prefix)
         if entry is None:
+            runner = self._route_longctx(prompt_row, max_new_tokens, prefix)
+            if runner is not None:
+                # the runner decodes whole rows (no incremental joiner);
+                # deliver its output at the engine's segment cadence so
+                # stream consumers see the same chunk contract. Tokens
+                # are the runner's verbatim — eos padding included.
+                res = runner.generate(
+                    prompt_row, max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    seed=seed, eos_id=eos_id,
+                    return_logprobs=return_logprobs)
+                toks, lps = res if return_logprobs else (res, None)
+                step = max(1, self.segment)
+                for c0 in range(0, toks.shape[1], step):
+                    if return_logprobs:
+                        yield (toks[:, c0:c0 + step], lps[:, c0:c0 + step])
+                    else:
+                        yield toks[:, c0:c0 + step]
+                    if eos_id is not None \
+                            and eos_id in toks[0, c0:c0 + step]:
+                        return
+                return
             yield from self.server.generate_stream(
                 prompt_row, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
@@ -2270,6 +2368,8 @@ class ContinuousBatcher:
                     "waiting_joiners": len(self._joiners),
                     **({"mesh": self._mesh_report_locked()}
                        if self.mesh_stats is not None else {}),
+                    **({"long_context": self._longctx.report()}
+                       if self._longctx is not None else {}),
                     **({"page_pool": self.pool.stats()}
                        if self.pool is not None else {})}
 

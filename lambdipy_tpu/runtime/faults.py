@@ -130,6 +130,11 @@ REGISTRY: dict[str, FaultSite] = {s.name: s for s in (
     FaultSite("session_pin", "store", _ENGINE_ENV,
               "the prefix store pinning a session's radix head (fails "
               "OPEN: the turn serves unpinned, counted)"),
+    FaultSite("offload_stall", "store", _ENGINE_ENV,
+              "the host offload arena's batched page re-online (delay "
+              "= a slow fetch, timed as a re-online stall; exception "
+              "= a FAILED re-online — the caller recomputes the page "
+              "via prefill, counted, never a wrong token)"),
     # fleet-layer (router/pool) network sites
     FaultSite("route_connect", "router", _FLEET_ENV,
               "the fleet router opening a replica connection"),

@@ -557,6 +557,18 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                                               "lookup"))
             dexit = extra.get("draft_exit",
                               _os.environ.get("LAMBDIPY_DRAFT_EXIT", "1"))
+            # long-context tier (runtime/longctx.py, DEFAULT OFF):
+            # max_logical_ctx > cache_len serves prompts past the
+            # compiled window through a sliding logical window whose
+            # evicted pages spill to a host offload arena (needs
+            # --kv-paged); long_prefill opts the tier's prefill side
+            # into the ring-attention path on sp meshes. Extra wins
+            # over env (`lambdipy serve --max-logical-ctx` bridge).
+            mlc = extra.get("max_logical_ctx",
+                            _os.environ.get("LAMBDIPY_MAX_LOGICAL_CTX",
+                                            "0"))
+            lpf = extra.get("long_prefill",
+                            _os.environ.get("LAMBDIPY_LONG_PREFILL", "0"))
             from lambdipy_tpu.runtime.faults import FaultPlan
 
             # paged KV memory (runtime/pagepool.py, DEFAULT OFF): one
@@ -613,7 +625,10 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 page_pool=page_pool,
                 spec_k=int(sk or 0),
                 draft_mode=str(dmode or "lookup"),
-                draft_exit=int(dexit or 1))
+                draft_exit=int(dexit or 1),
+                max_logical_ctx=int(mlc or 0),
+                long_prefill=str(lpf).lower() not in ("", "0", "false",
+                                                      "off"))
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 
@@ -683,6 +698,30 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                                 if raw_idle not in (None, "") else 600.0))
             if paged_pool is not None:
                 continuous.prefix_pages_fn = prefix_store.acquire_pages
+                # host KV offload tier (runtime/offload.py, DEFAULT
+                # OFF): swept-cold store pages spill their kvwire bytes
+                # to host RAM and re-online on demand instead of
+                # re-prefilling. kv_offload.* gauges ride
+                # batching.page_pool into /metrics via
+                # pool.attach_offload; kv_offload_mb budgets the host
+                # arena. Extra wins over env (`lambdipy serve
+                # --kv-offload` bridge).
+                kvo = extra.get(
+                    "kv_offload",
+                    _os_px.environ.get("LAMBDIPY_KV_OFFLOAD", "0"))
+                if str(kvo).lower() not in ("", "0", "false", "off"):
+                    from lambdipy_tpu.runtime.offload import OffloadArena
+
+                    raw_omb = _os_px.environ.get("LAMBDIPY_KV_OFFLOAD_MB")
+                    if raw_omb in (None, ""):
+                        raw_omb = extra.get("kv_offload_mb")
+                    prefix_store.attach_offload(OffloadArena(
+                        page=paged_pool.page,
+                        layers=server.model.cfg.layers,
+                        budget_mb=(float(raw_omb)
+                                   if raw_omb not in (None, "")
+                                   else 256.0),
+                        faults=continuous.faults))
 
     # disaggregated-serving KV ship surface (ROADMAP direction 4): a
     # prefill-class replica exports a prompt head's KV blocks as a wire
@@ -948,7 +987,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             return {"ok": False,
                     "error": "no continuous engine on this handler "
                              "(pipeline_depth/spec_k are engine knobs)"}
-        known = {"pipeline_depth", "spec_k", "draft_mode"}
+        known = {"pipeline_depth", "spec_k", "draft_mode",
+                 "max_logical_ctx"}
         unknown = sorted(set(req) - known)
         if unknown or not (set(req) & known):
             return {"ok": False,
@@ -1002,10 +1042,28 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # keep their adapted per-row provider (the fallback chain
             # still demotes them individually)
             continuous.draft_mode = dm
+        if "max_logical_ctx" in req:
+            try:
+                m = int(req["max_logical_ctx"])
+            except (TypeError, ValueError):
+                return {"ok": False,
+                        "error": "max_logical_ctx wants an int"}
+            if m != 0 and continuous.pool is None:
+                return {"ok": False,
+                        "error": "max_logical_ctx needs paged KV "
+                                 "(--kv-paged) at boot"}
+            m = max(0, m)
+            continuous.max_logical_ctx = m
+            if continuous._longctx is not None and m:
+                # a live runner re-reads its admission cap; 0 just
+                # stops routing (the runner idles, already-admitted
+                # runs finish)
+                continuous._longctx.max_logical_ctx = m
         return {"ok": True,
                 "pipeline_depth": continuous.pipeline_depth,
                 "spec_k": continuous.spec_k,
-                "draft_mode": continuous.draft_mode}
+                "draft_mode": continuous.draft_mode,
+                "max_logical_ctx": continuous.max_logical_ctx}
 
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a

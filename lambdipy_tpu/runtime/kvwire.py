@@ -294,6 +294,28 @@ def encode_chunk(start: int, blocks) -> bytes:
                      hbytes] + body)
 
 
+def pack_block_body(blocks, names) -> bytes:
+    """Serialize blocks into one contiguous ``LKVC`` body under an
+    ALREADY-DERIVED leaf-name order — the offload spill primitive
+    (runtime/offload.py): the caller derived the template once at
+    attach time, so the hot spill loop never pays
+    :func:`_leaf_template_of`'s per-array introspection again."""
+    return b"".join(_pack_body(blocks, names))
+
+
+def encode_chunk_packed(start: int, n_blocks: int, body: bytes) -> bytes:
+    """One ``LKVC`` frame over an already-packed ``body`` (see
+    :func:`pack_block_body`). Byte-identical to :func:`encode_chunk`'s
+    output for the same blocks, but the body bytes are REUSED — re-
+    framing an offloaded page for a batched re-online costs one small
+    JSON header, not a numpy re-serialization."""
+    header = {"v": 1, "start": int(start), "n_blocks": int(n_blocks),
+              "body": len(body)}
+    hbytes = json.dumps(header).encode()
+    return b"".join([CHUNK_MAGIC, struct.pack("<I", len(hbytes)),
+                     hbytes, body])
+
+
 def encode_stream(tokens, block: int, blocks, *,
                   group: int = 4) -> list[bytes]:
     """Whole-payload convenience (tests, scriptable stubs): the same

@@ -557,6 +557,107 @@ class PagePoolStats:
 
 
 @dataclass
+class KvOffloadStats:
+    """Counters for the paged-KV host-offload tier (the ``kv.offload``
+    block on ``/metrics``; residency gauges ride on
+    :meth:`lambdipy_tpu.runtime.offload.OffloadArena.gauges`, merged
+    into the pool's stats). ``spills``/``spill_pages`` count spill calls
+    and pages moved to host RAM, ``reonlines``/``reonline_pages`` the
+    batched fetch-and-write round trips back into the device arena
+    (``reonline_batches`` meters how well the prefetcher coalesces
+    them — one frame decode per batch, not per page), and
+    ``template_encodes`` every derivation of the kvwire leaf template
+    from live arrays — the hot loop must keep it at its attach-time
+    value (one), which ``tests/test_long_context.py`` asserts.
+    ``prefetch_hits`` are pages the decode-cursor prefetcher had
+    already re-onlined when attention demanded them; ``demand_misses``
+    stalled the dispatch (``stall_s`` accumulates that wait).
+    ``recomputes`` count failed re-onlines degraded to prefill
+    recompute — counted work, never a wrong token."""
+
+    spills: int = 0
+    spill_pages: int = 0
+    spill_bytes: int = 0
+    reonlines: int = 0
+    reonline_pages: int = 0
+    reonline_batches: int = 0
+    frame_decodes: int = 0
+    template_encodes: int = 0
+    prefetch_hits: int = 0
+    demand_misses: int = 0
+    stall_s: float = 0.0
+    stalls: int = 0
+    recomputes: int = 0
+    drops: int = 0
+    spill_refusals: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_spill(self, pages: int, nbytes: int) -> None:
+        with self._lock:
+            self.spills += 1
+            self.spill_pages += int(pages)
+            self.spill_bytes += int(nbytes)
+
+    def record_spill_refusal(self) -> None:
+        with self._lock:
+            self.spill_refusals += 1
+
+    def record_reonline(self, pages: int, *, batches: int = 1,
+                        decodes: int = 1) -> None:
+        with self._lock:
+            self.reonlines += 1
+            self.reonline_pages += int(pages)
+            self.reonline_batches += int(batches)
+            self.frame_decodes += int(decodes)
+
+    def record_template_encode(self) -> None:
+        with self._lock:
+            self.template_encodes += 1
+
+    def record_prefetch(self, hits: int, misses: int) -> None:
+        with self._lock:
+            self.prefetch_hits += int(hits)
+            self.demand_misses += int(misses)
+
+    def record_stall(self, seconds: float) -> None:
+        with self._lock:
+            self.stalls += 1
+            self.stall_s += float(seconds)
+
+    def record_recompute(self, pages: int = 1) -> None:
+        with self._lock:
+            self.recomputes += int(pages)
+
+    def record_drop(self, pages: int = 1) -> None:
+        with self._lock:
+            self.drops += int(pages)
+
+    def report(self) -> dict:
+        with self._lock:
+            demanded = self.prefetch_hits + self.demand_misses
+            return {
+                "spills": self.spills,
+                "spill_pages": self.spill_pages,
+                "spill_bytes": self.spill_bytes,
+                "reonlines": self.reonlines,
+                "reonline_pages": self.reonline_pages,
+                "reonline_batches": self.reonline_batches,
+                "frame_decodes": self.frame_decodes,
+                "template_encodes": self.template_encodes,
+                "prefetch_hits": self.prefetch_hits,
+                "demand_misses": self.demand_misses,
+                "prefetch_hit_rate": (
+                    round(self.prefetch_hits / demanded, 4)
+                    if demanded else 1.0),
+                "stalls": self.stalls,
+                "stall_s": round(self.stall_s, 6),
+                "recomputes": self.recomputes,
+                "drops": self.drops,
+                "spill_refusals": self.spill_refusals,
+            }
+
+
+@dataclass
 class KvShipStats:
     """Replica-side counters for the disaggregated-serving KV ship
     surface (the ``batching.disagg`` block on ``/metrics``). Exports are

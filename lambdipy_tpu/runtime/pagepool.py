@@ -125,6 +125,14 @@ class PagePool:
         # sanctioned order) so operators see pinned pages squeezing
         # arena headroom next to the refcount gauges
         self.pinned_fn: Callable[[], dict] | None = None
+        # optional host-offload tier (runtime/offload.py): ``offload``
+        # is the OffloadArena whose report() merges into stats() as the
+        # ``kv_offload`` block; ``temperature`` is the shared page-LRU
+        # tracker spill-victim selection reads. Both attach AFTER
+        # construction (attach_offload) so a pool without the long-
+        # context tier pays nothing.
+        self.offload: Any = None
+        self.temperature: Any = None
         self.stats_counters = PagePoolStats()
         self._lock = threading.RLock()
         # serializes the functional-arena chain (see module docstring);
@@ -341,7 +349,26 @@ class PagePool:
                 out.update(self.pinned_fn())
             except Exception:  # noqa: BLE001 — gauges must never break stats
                 pass
+        if self.offload is not None:
+            try:
+                out["kv_offload"] = self.offload.report()
+            except Exception:  # noqa: BLE001 — gauges must never break stats
+                pass
         return out
+
+    def attach_offload(self, offload: Any,
+                       temperature: Any = None) -> None:
+        """Wire the host offload tier in: the arena's ``kv.offload.*``
+        counters ride this pool's stats() (one merged block per pool on
+        /metrics) and the shared temperature tracker becomes the spill-
+        victim oracle for every consumer of this pool."""
+        self.offload = offload
+        if temperature is not None:
+            self.temperature = temperature
+        elif self.temperature is None:
+            from lambdipy_tpu.runtime.offload import PageTemperature
+
+            self.temperature = PageTemperature()
 
     def check_invariants(self) -> None:
         """Test hook: every page is free XOR live exactly once, refcounts

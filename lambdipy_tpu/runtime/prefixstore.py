@@ -129,10 +129,13 @@ class _Node:
     ``page_id`` names the arena page holding them (paged mode — the
     store owns one pool ref per node). ``pins`` counts live sessions
     holding this node: a pinned node is excluded from every eviction
-    sweep."""
+    sweep. ``off_key`` (paged mode with a host offload tier attached)
+    names this block's kvwire bytes in the offload arena when the page
+    was SPILLED instead of dropped — page_id is None then, and
+    :meth:`PrefixStore.acquire_pages` re-onlines it on demand."""
 
     __slots__ = ("parent", "token_key", "children", "kv", "nbytes",
-                 "last_used", "page_id", "pins")
+                 "last_used", "page_id", "pins", "off_key")
 
     def __init__(self, parent, token_key, kv=None, nbytes=0,
                  page_id=None):
@@ -144,6 +147,7 @@ class _Node:
         self.last_used = 0
         self.page_id = page_id
         self.pins = 0
+        self.off_key = None
 
 
 def _slices_bytes(slices) -> int:
@@ -228,6 +232,11 @@ class PrefixStore:
             # admission must never starve behind a cold cache: a short
             # pool alloc evicts this store's unshared LRU pages first
             pool.reclaim_fn = self.reclaim_pages
+        # host offload tier (runtime/offload.py), wired post-init by
+        # attach_offload(): swept-cold pages spill their kvwire bytes to
+        # host RAM instead of vanishing, and acquire_pages re-onlines
+        # them on demand through the validated page-write path
+        self.offload: Any = None
         self._clock = itertools.count(1)
         # target-path key -> Event: concurrent cold requests for the same
         # prefix wait for one device walk instead of duplicating it
@@ -261,6 +270,35 @@ class PrefixStore:
             # the pool calls this OUTSIDE its own lock)
             pool.pinned_fn = self._pool_pin_gauges
 
+    def attach_offload(self, offload: Any) -> None:
+        """Wire a host offload tier
+        (:class:`lambdipy_tpu.runtime.offload.OffloadArena`) into the
+        paged store: the LRU sweep SPILLS cold unshared pages to host
+        RAM (kvwire frames) instead of dropping them, and
+        :meth:`acquire_pages` re-onlines spilled blocks in one batched
+        frame decode on demand. The leaf template is seeded HERE, once,
+        from the store layout — the spill/re-online hot loop never
+        re-derives it (asserted by ``template_encodes`` staying at 1)."""
+        if self.pool is None:
+            raise ValueError("KV offload requires paged mode (pool=)")
+        template = self._leaf_template()
+        offload.attach_template(
+            [[name, dt.name, list(shape)]
+             for name, (shape, dt) in sorted(template.items())])
+        self.offload = offload
+        self.pool.attach_offload(offload)
+
+    @staticmethod
+    def _node_key(node: _Node) -> tuple:
+        """Offload-arena key of a node: the FULL token path from the
+        root — position-unique by construction (KV is RoPE'd before
+        store, so the same block tokens at two depths are two entries)."""
+        parts = []
+        while node is not None and node.token_key is not None:
+            parts.append(node.token_key)
+            node = node.parent
+        return tuple(t for key in reversed(parts) for t in key)
+
     # -- host-side matching --------------------------------------------------
 
     def _target_len(self, n_tokens: int) -> int:
@@ -292,12 +330,23 @@ class PrefixStore:
                 or self._arena_gen == self.pool.arena_generation:
             return
         self._arena_gen = self.pool.arena_generation
+        dead_keys = []
         for node in list(self._iter_nodes()):
             if node.page_id is not None:
                 self.pool.release([node.page_id])
                 self.stats_counters.record_evict(1, node.nbytes)
                 node.page_id = None
+            if node.off_key is not None:
+                # host bytes survive an arena reset, but the tree drops
+                # wholesale — unreachable entries must not leak budget
+                dead_keys.append(node.off_key)
+                node.off_key = None
             node.pins = 0
+        if dead_keys and self.offload is not None:
+            try:
+                self.offload.drop(dead_keys)
+            except Exception:  # noqa: BLE001 — cleanup must not block flush
+                pass
         # session pins die with the stale tree — OBSERVABLY: the next
         # turn re-prefills its whole head through the normal walk (a
         # counted, bounded recovery) and re-pins fresh nodes
@@ -384,7 +433,15 @@ class PrefixStore:
         routing, or an explicit client prefix that never walked this
         tree) — the caller then serves through the dense fallback.
         Retain happens under the store lock, so a concurrent LRU sweep
-        cannot release a page between the match and the bump."""
+        cannot release a page between the match and the bump.
+
+        With a host offload tier attached, blocks whose pages were
+        SPILLED re-online here — one batched kvwire frame decode for all
+        missing blocks, written back through the validated page-write
+        path — before the handout. A failed re-online (offload fault,
+        dropped entry, page famine) degrades to None: the caller's dense
+        fallback recomputes the prefix via prefill — counted
+        (``kv_offload.recomputes``), never a wrong token."""
         if self.pool is None:
             return None
         try:
@@ -395,17 +452,104 @@ class PrefixStore:
             return None
         with self._lock:
             self._maybe_flush_stale_locked()
-            node, m, pids = self._root, 0, []
+            node, m, path = self._root, 0, []
             while m < len(row):
                 child = node.children.get(tuple(row[m:m + self.block]))
-                if child is None or child.page_id is None:
+                if child is None or (child.page_id is None
+                                     and child.off_key is None):
                     return None
                 child.last_used = next(self._clock)
-                pids.append(child.page_id)
+                path.append(child)
                 node = child
                 m += self.block
-            self.pool.retain(pids)
+            missing = [n for n in path if n.page_id is None]
+            # retain the resident pages FIRST: the re-online alloc may
+            # re-enter the reclaim sweep, and a refcount of 2 is what
+            # keeps the sweep's hands off the path we are handing out
+            resident = [n.page_id for n in path if n.page_id is not None]
+            self.pool.retain(resident)
+            if missing and not self._reonline_locked(missing):
+                self.pool.release(resident)
+                return None
+            fresh = [n.page_id for n in missing]
+            self.pool.retain(fresh)
+            pids = [n.page_id for n in path]
         return pids, m
+
+    def _reonline_locked(self, nodes: list) -> bool:
+        """Bring spilled blocks back into the arena, under the store
+        lock: ONE batched fetch (one frame decode for the whole batch),
+        one alloc, chained page writes under the arena lock with a
+        generation guard. On success every node holds a fresh page (the
+        store's ref) and its offload entry is dropped. Any failure
+        returns False with nothing leaked — the caller serves dense."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from lambdipy_tpu.runtime.offload import OffloadMiss
+        from lambdipy_tpu.runtime.pagepool import PagesExhausted
+
+        pool = self.pool
+        stats = getattr(self.offload, "stats", None)
+        keys = [n.off_key for n in nodes]
+        if self.offload is None or any(k is None for k in keys):
+            return False
+        try:
+            blocks = self.offload.fetch_many(keys)
+        except OffloadMiss as e:
+            # the entries are GONE (dropped by a racer or an operator):
+            # retrying every walk is pointless — prune from the
+            # shallowest ghost down (the path is a chain, so that
+            # subtree holds every deeper node) and the next request
+            # prefills the range fresh
+            log.error("spilled prefix blocks missing from the offload "
+                      "arena (recomputing via prefill): %s", e)
+            self._prune_subtree_locked(nodes[0])
+            if stats is not None:
+                stats.record_recompute(len(keys))
+            return False
+        except Exception as e:  # noqa: BLE001 — injected faults, transient IO
+            log.error("page re-online failed (recomputing via "
+                      "prefill): %s", e)
+            if stats is not None:
+                stats.record_recompute(len(keys))
+            return False
+        try:
+            pids = pool.alloc(len(nodes), tokens=len(nodes) * self.block,
+                              record_shed=False)
+        except PagesExhausted:
+            if stats is not None:
+                stats.record_recompute(len(keys))
+            return False
+        write = self.server._page_write_fn(pool.n_pages, pool.page)
+        try:
+            with pool.arena_lock, self.server._mesh_ctx():
+                if pool.arena_generation != self._arena_gen:
+                    # the arena reset between walk and write: staged
+                    # content would be stale — the flush sweep owns
+                    # cleanup, this handout just fails dense
+                    pool.release(pids)
+                    return False
+                arena = pool.ensure_arena()
+                for pid, blk in zip(pids, blocks):
+                    jblk = [{name: jnp.asarray(np.asarray(val))
+                             for name, val in entry.items()}
+                            for entry in blk]
+                    arena = write(arena, jnp.int32(pid), jblk)
+                pool.arena = arena
+        except Exception as e:  # noqa: BLE001 — a failed write leaks nothing
+            log.error("page re-online write failed (recomputing via "
+                      "prefill): %s", e)
+            pool.release(pids)
+            if stats is not None:
+                stats.record_recompute(len(keys))
+            return False
+        for node, pid in zip(nodes, pids):
+            node.page_id = pid
+            node.off_key = None
+            self.stats_counters.record_insert(1, node.nbytes)
+        self.offload.drop(keys)
+        return True
 
     # -- session pins (multi-turn chat) ---------------------------------------
 
@@ -593,12 +737,16 @@ class PrefixStore:
         """Longest prefix of a BLOCK-ALIGNED ``row`` whose blocks are
         all actually present (dense ``kv`` or paged ``page_id`` still
         live — ``_match_locked`` caps one block short for continuation
-        routing; the ship surface needs the whole head). Returns
+        routing; the ship surface needs the whole head). A SPILLED
+        paged block (``off_key`` set, host bytes live) counts as
+        present: probe and export both serve it, so a failover re-ship
+        includes a partially-offloaded row's whole history. Returns
         ``(present token count, path nodes)``."""
         node, m, path = self._root, 0, []
         while m < len(row):
             child = node.children.get(tuple(row[m:m + self.block]))
-            if child is None or (child.page_id is None
+            if child is None or ((child.page_id is None
+                                  and child.off_key is None)
                                  if self.pool is not None
                                  else child.kv is None):
                 break
@@ -649,6 +797,7 @@ class PrefixStore:
             return None
         head = row[:m]
         pids: list = []
+        offs: list = []
         kvs: list = []
         for attempt in range(2):
             with self._lock:
@@ -660,9 +809,13 @@ class PrefixStore:
                     if self.pool is not None:
                         # pin under the validating lock: a concurrent
                         # LRU release-and-reuse must not swap page
-                        # content between the walk and the host read
+                        # content between the walk and the host read.
+                        # Spilled blocks (page_id None) ride their
+                        # off_key instead — host bytes need no pin.
                         pids = [n.page_id for n in path]
-                        self.pool.retain(pids)
+                        offs = [n.off_key for n in path]
+                        self.pool.retain(
+                            [p for p in pids if p is not None])
                     else:
                         # python refs keep the slices alive even if the
                         # budget sweep drops the nodes meanwhile
@@ -678,15 +831,43 @@ class PrefixStore:
             try:
                 with self.pool.arena_lock:
                     arena = self.pool.ensure_arena()
-                blocks = [arena_page_slices(arena, pid, self.pool.page)
-                          for pid in pids]
+                fetched = self._fetch_offloaded(
+                    [k for p, k in zip(pids, offs)
+                     if p is None and k is not None])
+                blocks = []
+                for pid, key in zip(pids, offs):
+                    if pid is not None:
+                        blocks.append(arena_page_slices(
+                            arena, pid, self.pool.page))
+                    elif key in fetched:
+                        blocks.append(fetched[key])
+                    else:
+                        # a racer re-onlined-and-dropped or the entry
+                        # died: truncate at the first unreadable block —
+                        # the decode side prefills the tail locally
+                        break
+                head = head[:len(blocks) * bk]
             finally:
-                self.pool.release(pids)
+                self.pool.release([p for p in pids if p is not None])
         else:
             blocks = [[{name: np.asarray(val)
                         for name, val in entry.items()}
                        for entry in kv] for kv in kvs]
         return head, blocks
+
+    def _fetch_offloaded(self, keys: list) -> dict:
+        """Read-only batched fetch of spilled blocks for the export
+        surfaces (entries stay offloaded — an export must not churn
+        residency). Returns ``{key: numpy block}``; failures return
+        what could not be read as ABSENT, and the caller truncates."""
+        if not keys or self.offload is None:
+            return {}
+        try:
+            return dict(zip(keys, self.offload.fetch_many(keys)))
+        except Exception as e:  # noqa: BLE001 — export truncates, never fails
+            log.error("offloaded-block fetch failed during export "
+                      "(truncating): %s", e)
+            return {}
 
     def import_blocks(self, tokens, blocks) -> dict:
         """Register shipped whole-block KV under ``tokens`` — a ship
@@ -801,10 +982,22 @@ class PrefixStore:
         key = self.server._prefix_key(head)
         target = len(head)
         while True:
-            owner, waiter, pinned, kvs = False, None, [], []
+            owner, waiter, pinned, offs, kvs = False, None, [], [], []
             with self._lock:
                 self._maybe_flush_stale_locked()
                 present, path = self._present_locked(head)
+                if present < target and self.pool is not None:
+                    # the cold-walk tail GATHERS the present prefix back
+                    # into a contiguous cache — that read needs RESIDENT
+                    # pages, so clamp the reusable prefix at the first
+                    # spilled block (the walk re-prefills from there:
+                    # correct, just less reuse)
+                    res = 0
+                    for n in path:
+                        if n.page_id is None:
+                            break
+                        res += self.block
+                    present, path = res, path[:res // self.block]
                 if present < target:
                     waiter = self._inflight.get(key)
                     if waiter is None:
@@ -814,17 +1007,22 @@ class PrefixStore:
                     if self.pool is not None:
                         # pin under the validating lock (the export_blocks
                         # rule): an LRU release-and-reuse must not swap
-                        # page content before the host read
+                        # page content before the host read; spilled
+                        # blocks ride their off_key, no pin needed
                         pinned = [n.page_id for n in path]
-                        self.pool.retain(pinned)
+                        offs = [n.off_key for n in path]
+                        self.pool.retain(
+                            [p for p in pinned if p is not None])
                     else:
                         kvs = [n.kv for n in path]
             if present >= target:
                 try:
-                    yield from self._read_block_groups(pinned, kvs, group)
+                    yield from self._read_block_groups(pinned, kvs, group,
+                                                       offs)
                 finally:
                     if pinned:
-                        self.pool.release(pinned)
+                        self.pool.release(
+                            [p for p in pinned if p is not None])
                 return
             if not owner:
                 # another thread owns the walk for this very prefix:
@@ -835,21 +1033,27 @@ class PrefixStore:
                         "another thread did not complete within 300s")
                 continue
             try:
-                yield from self._read_block_groups(pinned, kvs, group)
+                yield from self._read_block_groups(pinned, kvs, group,
+                                                   offs)
                 yield from self._walk_stream(head, present, pinned, kvs)
             finally:
                 if pinned:
-                    self.pool.release(pinned)
+                    self.pool.release(
+                        [p for p in pinned if p is not None])
                 with self._lock:
                     event = self._inflight.pop(key, None)
                 if event is not None:
                     event.set()
             return
 
-    def _read_block_groups(self, pinned: list, kvs: list, group: int):
+    def _read_block_groups(self, pinned: list, kvs: list, group: int,
+                           offs: list | None = None):
         """Yield the already-present prefix as numpy block groups —
-        paged reads ride the held refs in ``pinned``, dense reads the
-        python refs in ``kvs``."""
+        paged reads ride the held refs in ``pinned`` (a None pin is a
+        SPILLED block, read from the offload arena via the matching
+        ``offs`` key — one batched fetch per group), dense reads the
+        python refs in ``kvs``. An unreadable spilled block truncates
+        the stream, which the receiver detects by construction."""
         import numpy as np
 
         if self.pool is not None:
@@ -859,9 +1063,25 @@ class PrefixStore:
 
             with self.pool.arena_lock:
                 arena = self.pool.ensure_arena()
+            offs = offs if offs else [None] * len(pinned)
             for i in range(0, len(pinned), group):
-                yield [arena_page_slices(arena, pid, self.pool.page)
-                       for pid in pinned[i:i + group]]
+                g_pids = pinned[i:i + group]
+                g_offs = offs[i:i + group]
+                fetched = self._fetch_offloaded(
+                    [k for p, k in zip(g_pids, g_offs)
+                     if p is None and k is not None])
+                out = []
+                for pid, okey in zip(g_pids, g_offs):
+                    if pid is not None:
+                        out.append(arena_page_slices(
+                            arena, pid, self.pool.page))
+                    elif okey in fetched:
+                        out.append(fetched[okey])
+                    else:
+                        if out:
+                            yield out
+                        return
+                yield out
         else:
             for i in range(0, len(kvs), group):
                 yield [[{name: np.asarray(val)
@@ -1279,24 +1499,105 @@ class PrefixStore:
         per leaf) turned page pressure into admission-latency spikes.
         A parent whose whole chain became evictable frees on the next
         sweep (pressure recurs; convergence does not need cascading
-        here)."""
+        here).
+
+        With a host offload tier attached the victim's page SPILLS —
+        its kvwire bytes move to host RAM and the node stays in the
+        tree as a ghost (``off_key`` set, page released), so a later
+        hit re-onlines it instead of re-prefilling. A spill refusal
+        (offload budget full) falls back to today's drop. LRU order
+        (``last_used``) is the temperature signal: the coldest pages
+        leave the arena first."""
         refs = self.pool.snapshot_refs()
+        nodes = list(self._iter_nodes())
         # pinned leaves are invisible to the sweep: an open session's
         # conversation KV must survive cache pressure — that retention
         # is bounded by the PIN budget, not the LRU budget
-        leaves = [node for node in self._iter_nodes()
-                  if not node.children and node.page_id is not None
-                  and not node.pins
-                  and refs.get(node.page_id, 0) == 1]
+        if self.offload is not None:
+            # "leaf" relaxes to "no RESIDENT descendant": a spilled
+            # child is a ghost (host bytes, no page) and must not
+            # shield its parent's cold page from the sweep — that
+            # would wedge reclaim behind the very pages spilling is
+            # meant to free
+            blocked: set[int] = set()
+            for node in nodes:
+                if node.page_id is not None:
+                    p = node.parent
+                    while p is not None and id(p) not in blocked:
+                        blocked.add(id(p))
+                        p = p.parent
+            leaves = [node for node in nodes
+                      if node.page_id is not None
+                      and id(node) not in blocked and not node.pins
+                      and refs.get(node.page_id, 0) == 1]
+        else:
+            leaves = [node for node in nodes
+                      if not node.children and node.page_id is not None
+                      and not node.pins
+                      and refs.get(node.page_id, 0) == 1]
         leaves.sort(key=lambda node: node.last_used)
+        victims = leaves[:max(0, int(n))]
+        arena = None
+        if self.offload is not None and victims:
+            with self.pool.arena_lock:
+                arena = self.pool.ensure_arena()
         freed = 0
-        for victim in leaves[:max(0, int(n))]:
-            victim.parent.children.pop(victim.token_key, None)
-            self.stats_counters.record_evict(1, victim.nbytes)
-            self.pool.release([victim.page_id])
-            victim.page_id = None
+        for victim in victims:
+            spilled, key = False, None
+            if arena is not None:
+                from lambdipy_tpu.models.llama import arena_page_slices
+
+                key = self._node_key(victim)
+                try:
+                    block = arena_page_slices(arena, victim.page_id,
+                                              self.pool.page)
+                    spilled = self.offload.spill(
+                        key, victim.token_key, block)
+                except Exception as e:  # noqa: BLE001 — drop instead
+                    log.error("page spill failed (dropping page "
+                              "instead): %s", e)
+            if spilled:
+                victim.off_key = key
+                self.stats_counters.record_evict(1, victim.nbytes)
+                self.pool.release([victim.page_id])
+                victim.page_id = None
+            else:
+                # drop fallback: the whole subtree below the victim is
+                # ghosts by construction (no resident descendant) and
+                # becomes unreachable — prune it consistently
+                self._prune_subtree_locked(victim)
             freed += 1
         return freed
+
+    def _prune_subtree_locked(self, node: _Node) -> None:
+        """Detach ``node`` and clean its WHOLE subtree: resident pages
+        release (evict-counted), spilled entries drop, pin accounting
+        settles. Nothing unreachable may keep a page, a host byte, or
+        a counter."""
+        if node.parent is not None:
+            node.parent.children.pop(node.token_key, None)
+        keys = []
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if cur.page_id is not None:
+                self.pool.release([cur.page_id])
+                self.stats_counters.record_evict(1, cur.nbytes)
+                cur.page_id = None
+            if cur.off_key is not None:
+                keys.append(cur.off_key)
+                cur.off_key = None
+            if cur.pins > 0:
+                self._pinned_bytes -= cur.nbytes
+                self._pinned_leaves -= 1
+                cur.pins = 0
+            stack.extend(cur.children.values())
+            cur.children = {}
+        if keys and self.offload is not None:
+            try:
+                self.offload.drop(keys)
+            except Exception:  # noqa: BLE001 — cleanup must not fail a prune
+                pass
 
     def _evict_locked(self) -> None:
         """LRU leaf eviction until the budget holds (leaves only: an
@@ -1389,6 +1690,14 @@ class PrefixStore:
                             f"tree references page {n.page_id} with no "
                             f"live pool ref")
                         break
+            ghosts = [n for n in nodes if n.off_key is not None]
+            for n in ghosts:
+                if n.page_id is not None:
+                    violations.append(
+                        f"node holds page {n.page_id} AND offload key "
+                        f"{n.off_key!r} — spill/re-online must be "
+                        f"exclusive")
+                    break
             return {
                 "ok": not violations,
                 "violations": violations,
@@ -1397,6 +1706,7 @@ class PrefixStore:
                 "pinned_bytes": nbytes,
                 "blocks": len(content),
                 "bytes": content_bytes,
+                "offloaded_blocks": len(ghosts),
                 "paged": self.pool is not None,
             }
 

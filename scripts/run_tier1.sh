@@ -360,4 +360,22 @@ if ! timeout -k 10 870 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 16"
+
+# Phase 17: long-context capacity gate — bench.py --long-context
+# serves logical contexts at 8x/16x/32x the compiled window through
+# the sliding-window runner + paged-KV host offload inside ONE fixed
+# page budget (a single compiled window of pages plus two slack) and
+# exits nonzero if the pool sheds any work, if a within-window row
+# diverges bitwise from the dense solo path, if TTFT grows
+# superlinearly or tok/s cliffs between multipliers, if the re-online
+# stall fraction exceeds its bound with the decode-cursor prefetch
+# live (resident_cap churn forces real spills), or if the hot loop
+# re-encodes the kvwire leaf template more than once.
+phase_begin "phase 17: long-context capacity gate (bench.py --long-context)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --long-context; then
+    echo "FATAL: bench.py --long-context gate failed" >&2
+    exit 1
+fi
+phase_end "phase 17"
 exit 0

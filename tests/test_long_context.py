@@ -1,0 +1,482 @@
+"""Long-context tier: sliding logical window over the compiled one,
+paged-KV host offload, decode-cursor prefetch, and the engine routing.
+
+The acceptance bar is the serve-path standard: every byte that leaves
+the arena must come back BITWISE (spill -> fetch round trips, exports
+with offloaded blocks, re-onlined pages), the windowed runner's output
+is bitwise the plain path's wherever both exist (contexts that fit the
+window; churned vs unchurned views), degradation is counted and
+token-exact (an injected ``offload_stall`` failure replays, never
+corrupts), and the hot loop never re-derives the leaf template
+(``template_encodes`` stays at 1)."""
+
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models.llama import init_page_arena, page_kv_bytes
+from lambdipy_tpu.runtime.offload import (
+    INFLIGHT,
+    OFFLOADED,
+    RESIDENT,
+    OffloadArena,
+    OffloadMiss,
+    PageTemperature,
+    Prefetcher,
+)
+from lambdipy_tpu.runtime.pagepool import PagePool, page_width
+
+BLOCK = 16
+
+
+@pytest.fixture(scope="module")
+def tiny_server():
+    from lambdipy_tpu.models import registry
+
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    return adapter.make_server(params)
+
+
+def mk_pool(server, *, n_windows=2, extra_pages=0, block=BLOCK):
+    cfg = server.model.cfg
+    page = page_width(cfg.max_len, block)
+    n_pages = n_windows * (cfg.max_len // page) + 1 + extra_pages
+    return PagePool(n_pages=n_pages, page=page,
+                    page_bytes=page_kv_bytes(cfg, page),
+                    make_arena=lambda: init_page_arena(cfg, n_pages,
+                                                       page))
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(5, 100, size=n).tolist()
+
+
+def _block_bytes(block):
+    return b"".join(np.asarray(v).tobytes()
+                    for entry in block
+                    for _, v in sorted(entry.items()))
+
+
+def _fake_block(layers=2, kvh=2, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"k": rng.random((1, BLOCK, kvh, d), dtype=np.float32),
+             "v": rng.random((1, BLOCK, kvh, d), dtype=np.float32)}
+            for _ in range(layers)]
+
+
+# -- the windowed attention oracle -------------------------------------------
+
+
+def test_windowed_reference_matches_full_at_base_zero():
+    """With base=0 and window=T the logical-window oracle IS the plain
+    reference — bitwise, not allclose (slice of the whole is the
+    whole)."""
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.ops.decode_attention import (
+        decode_attention_reference,
+        windowed_decode_attention_reference,
+    )
+
+    rng = np.random.default_rng(3)
+    b, t, h, kvh, d = 2, 32, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, kvh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, kvh, d)), jnp.float32)
+    lens = jnp.asarray([t, t - 5], jnp.int32)
+    full = decode_attention_reference(q, k, v, lens)
+    win = windowed_decode_attention_reference(
+        q, k, v, jnp.zeros((b,), jnp.int32), lens, t)
+    assert np.array_equal(np.asarray(full), np.asarray(win))
+
+
+def test_windowed_reference_slides_exactly():
+    """A based view equals the reference run on the pre-sliced cache —
+    the shape-identity argument the windowed paged path rests on."""
+    import jax.numpy as jnp
+
+    from lambdipy_tpu.ops.decode_attention import (
+        decode_attention_reference,
+        windowed_decode_attention_reference,
+    )
+
+    rng = np.random.default_rng(4)
+    b, t, window, h, kvh, d = 2, 64, 32, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, kvh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, kvh, d)), jnp.float32)
+    base = np.asarray([8, 24], np.int32)
+    local = jnp.asarray([window, window - 3], jnp.int32)
+    win = windowed_decode_attention_reference(
+        q, k, v, jnp.asarray(base), local, window)
+    manual = decode_attention_reference(
+        q,
+        jnp.stack([k[r, base[r]:base[r] + window] for r in range(b)]),
+        jnp.stack([v[r, base[r]:base[r] + window] for r in range(b)]),
+        local)
+    assert np.array_equal(np.asarray(win), np.asarray(manual))
+
+
+# -- offload arena: spill / re-online round trip ------------------------------
+
+
+def test_offload_roundtrip_bitwise_and_single_template_encode():
+    arena = OffloadArena(page=BLOCK, layers=2)
+    blocks = {("b", i): _fake_block(seed=i) for i in range(3)}
+    toks = {k: tuple(range(i * BLOCK, (i + 1) * BLOCK))
+            for i, k in enumerate(blocks)}
+    for key, blk in blocks.items():
+        assert arena.spill(key, toks[key], blk)
+    assert len(arena) == 3
+    got = arena.fetch_many(list(blocks))
+    for key, out in zip(blocks, got):
+        assert _block_bytes(out) == _block_bytes(blocks[key])
+    # idempotent: a second fetch reads the same bytes (spill keeps the
+    # entry until an explicit drop)
+    again = arena.fetch_many(list(blocks))
+    for out, out2 in zip(got, again):
+        assert _block_bytes(out) == _block_bytes(out2)
+    rep = arena.report()
+    # the whole session derived the leaf template exactly once — the
+    # hot loop ships cached body bytes, it never re-encodes
+    assert rep["template_encodes"] == 1
+    # one frame decode per BATCH, not per page
+    assert rep["frame_decodes"] == 2
+    assert rep["reonline_pages"] == 6
+    arena.drop(list(blocks))
+    assert len(arena) == 0
+    with pytest.raises(OffloadMiss):
+        arena.fetch_many([("b", 0)])
+
+
+def test_offload_budget_refusal_counted():
+    blk = _fake_block()
+    per = len(_block_bytes(blk))
+    arena = OffloadArena(page=BLOCK, layers=2,
+                         budget_mb=1.5 * per / 2**20)
+    assert arena.spill(("k", 0), tuple(range(BLOCK)), blk)
+    assert not arena.spill(("k", 1), tuple(range(BLOCK)),
+                           _fake_block(seed=1))
+    rep = arena.report()
+    assert rep["spill_refusals"] == 1
+    assert len(arena) == 1
+
+
+def test_offload_stall_fault_delay_and_exception():
+    import time
+
+    from lambdipy_tpu.runtime.faults import FaultPlan
+
+    blk = _fake_block()
+    arena = OffloadArena(
+        page=BLOCK, layers=2,
+        faults=FaultPlan.from_spec("offload_stall:delay@ms=80"))
+    assert arena.spill(("k", 0), tuple(range(BLOCK)), blk)
+    t0 = time.monotonic()
+    out = arena.fetch_many([("k", 0)])
+    assert time.monotonic() - t0 >= 0.05
+    assert _block_bytes(out[0]) == _block_bytes(blk)
+
+    arena2 = OffloadArena(
+        page=BLOCK, layers=2,
+        faults=FaultPlan.from_spec("offload_stall:exception"))
+    assert arena2.spill(("k", 0), tuple(range(BLOCK)), blk)
+    with pytest.raises(Exception):
+        arena2.fetch_many([("k", 0)])
+    # the fault fired once; the entry survives and serves afterwards
+    out = arena2.fetch_many([("k", 0)])
+    assert _block_bytes(out[0]) == _block_bytes(blk)
+
+
+# -- prefetcher state machine --------------------------------------------------
+
+
+def test_prefetcher_state_machine():
+    p = Prefetcher()
+    keys = [("r", 0), ("r", 1), ("r", 2)]
+    p.spill(keys)
+    assert all(p.state(k) == OFFLOADED for k in keys)
+    # plan moves OFFLOADED -> INFLIGHT and returns exactly those
+    planned = p.plan([("r", 0), ("r", 1), ("x", 9)])
+    assert sorted(planned) == [("r", 0), ("r", 1)]
+    assert p.state(("r", 0)) == INFLIGHT
+    assert p.plan([("r", 0)]) == []  # already inflight: no double fetch
+    p.complete([("r", 0), ("r", 1)])
+    assert p.state(("r", 0)) == RESIDENT
+    # demand over the whole view: resident keys score ONE hit each and
+    # leave the tracker; the never-offloaded key is invisible
+    miss = p.demand([("r", 0), ("r", 1), ("r", 2), ("never", 1)])
+    assert miss == [("r", 2)]
+    # hit keys leave the tracker (untracked defaults to resident), so a
+    # page resident for fifty more segments scores exactly one hit
+    assert ("r", 0) not in p._state
+    rep = p.stats.report()
+    assert rep["prefetch_hits"] == 2 and rep["demand_misses"] == 1
+    # a demanded miss is INFLIGHT now (the caller re-onlines it timed);
+    # demand again must not double-count it
+    assert p.state(("r", 2)) == INFLIGHT
+    p.forget([("r", 2)])
+    assert p.demand([("r", 2)]) == []
+
+
+def test_page_temperature_orders_by_recency():
+    t = PageTemperature()
+    t.touch(["a", "b"])
+    t.touch(["b"])
+    t.touch(["c"])
+    assert t.coldest(["a", "b", "c"], 2) == ["a", "b"]
+    # untracked keys rank coldest of all
+    assert t.coldest(["z", "b"], 1) == ["z"]
+    t.forget(["b"])
+    assert t.coldest(["b", "c"], 1) == ["b"]
+
+
+# -- the long-context runner ---------------------------------------------------
+
+
+def test_runner_short_context_bitwise_vs_dense(tiny_server):
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+
+    pool = mk_pool(tiny_server, extra_pages=4)
+    runner = LongContextRunner(tiny_server, pool, segment=8)
+    cfg = tiny_server.model.cfg
+    row = _prompt(cfg.max_len // 2, seed=5)
+    got = runner.generate(row, max_new_tokens=12)
+    want = tiny_server.generate(row, max_new_tokens=12)
+    assert np.array_equal(got, want)
+    s_got = runner.generate(row, max_new_tokens=12, temperature=0.7,
+                            seed=11)
+    s_want = tiny_server.generate(row, max_new_tokens=12,
+                                  temperature=0.7, seed=11)
+    assert np.array_equal(s_got, s_want)
+    pool.check_invariants()
+    assert pool.free_count() == pool.capacity_pages
+
+
+def test_runner_long_context_fixed_budget_deterministic(tiny_server):
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+
+    cfg = tiny_server.model.cfg
+    pool = mk_pool(tiny_server, n_windows=1, extra_pages=2)
+    runner = LongContextRunner(tiny_server, pool, segment=8,
+                               max_logical_ctx=8 * cfg.max_len)
+    row = _prompt(3 * cfg.max_len, seed=6)  # 3x the compiled window
+    out1 = runner.generate(row, max_new_tokens=16)
+    out2 = runner.generate(row, max_new_tokens=16)
+    assert np.array_equal(out1, out2)
+    assert pool.free_count() == pool.capacity_pages  # zero page leaks
+    rep = runner.report()
+    assert rep["spill_pages"] > 0          # the slide really offloaded
+    assert rep["template_encodes"] == 1    # zero hot-loop re-encodes
+    pool.check_invariants()
+
+
+def test_runner_churn_bitwise_vs_unchurned(tiny_server):
+    """resident_cap yields cold view pages between segments and
+    prefetches them back — tokens must be bitwise the unchurned run's,
+    and the prefetch must actually score (hits, no demand stalls)."""
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+
+    cfg = tiny_server.model.cfg
+    pool = mk_pool(tiny_server, n_windows=1, extra_pages=2)
+    base = LongContextRunner(tiny_server, pool, segment=8,
+                             max_logical_ctx=8 * cfg.max_len)
+    row = _prompt(3 * cfg.max_len, seed=7)
+    want = base.generate(row, max_new_tokens=16)
+    churn = LongContextRunner(tiny_server, pool, segment=8,
+                              max_logical_ctx=8 * cfg.max_len,
+                              resident_cap=base.n_view - 2)
+    got = churn.generate(row, max_new_tokens=16)
+    assert np.array_equal(got, want)
+    rep = churn.report()
+    assert rep["prefetch_hits"] > 0
+    assert rep["stalls"] == 0 and rep["recomputes"] == 0
+    assert rep["prefetch_hit_rate"] == 1.0
+    assert pool.free_count() == pool.capacity_pages
+    pool.check_invariants()
+
+
+def test_runner_failed_reonline_replays_token_exact(tiny_server):
+    """An armed offload_stall exception kills the churn run's prefetch;
+    the runner replays with yielding disabled and emits IDENTICAL
+    tokens — a counted recompute, never a wrong token."""
+    from lambdipy_tpu.runtime.faults import FaultPlan
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+
+    cfg = tiny_server.model.cfg
+    pool = mk_pool(tiny_server, n_windows=1, extra_pages=2)
+    clean = LongContextRunner(tiny_server, pool, segment=8,
+                              max_logical_ctx=8 * cfg.max_len)
+    row = _prompt(3 * cfg.max_len, seed=8)
+    want = clean.generate(row, max_new_tokens=16)
+    # a fresh pool so the faulty runner builds its OWN arena with the
+    # fault armed (sharing the pool would adopt clean's fault-free one)
+    pool_f = mk_pool(tiny_server, n_windows=1, extra_pages=2)
+    faulty = LongContextRunner(
+        tiny_server, pool_f, segment=8,
+        max_logical_ctx=8 * cfg.max_len,
+        resident_cap=clean.n_view - 2,
+        faults=FaultPlan.from_spec("offload_stall:exception"))
+    got = faulty.generate(row, max_new_tokens=16)
+    assert np.array_equal(got, want)
+    rep = faulty.report()
+    assert rep["recomputes"] > 0
+    assert pool_f.free_count() == pool_f.capacity_pages
+    pool_f.check_invariants()
+
+
+def test_runner_rejects_over_cap(tiny_server):
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+
+    cfg = tiny_server.model.cfg
+    pool = mk_pool(tiny_server)
+    runner = LongContextRunner(tiny_server, pool, segment=8,
+                               max_logical_ctx=2 * cfg.max_len)
+    assert not runner.fits(3 * cfg.max_len, 16)
+    with pytest.raises(ValueError):
+        runner.generate(_prompt(3 * cfg.max_len), max_new_tokens=16)
+
+
+# -- prefix store spill / re-online / failover re-ship -------------------------
+
+
+def test_store_spill_reonline_and_mixed_export(tiny_server):
+    from lambdipy_tpu.models.llama import arena_page_slices
+    from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+    pool = mk_pool(tiny_server, extra_pages=4)
+    store = PrefixStore(tiny_server, pool=pool)
+    store.attach_offload(OffloadArena(page=pool.page,
+                                      layers=tiny_server.model.cfg.layers))
+    row = _prompt(65, seed=9)
+    m = store.route(row)
+    assert m == 64
+    head, before = store.export_blocks(row)
+    assert len(head) == m
+
+    # PARTIAL spill: two sweep rounds offload the two deepest blocks
+    assert store.reclaim_pages(1) == 1
+    assert store.reclaim_pages(1) == 1
+    inv = store.check_invariants()
+    assert inv["ok"], inv
+    assert inv["offloaded_blocks"] == 2 and inv["blocks"] == 2
+
+    # the failover re-ship includes the offloaded pages, bitwise
+    head2, mixed = store.export_blocks(row)
+    assert head2 == head and len(mixed) == len(before)
+    for a, b in zip(mixed, before):
+        assert _block_bytes(a) == _block_bytes(b)
+
+    # a hit re-onlines the ghosts in ONE batch and hands out live pages
+    res = store.acquire_pages(row[:m])
+    assert res is not None
+    pids, got = res
+    assert got == m
+    inv2 = store.check_invariants()
+    assert inv2["ok"] and inv2["offloaded_blocks"] == 0
+    with pool.arena_lock:
+        arena = pool.ensure_arena()
+    for pid, b in zip(pids, before):
+        assert _block_bytes(arena_page_slices(arena, pid, pool.page)) \
+            == _block_bytes(b)
+    pool.release(pids)
+    pool.check_invariants()
+
+
+def test_store_failover_import_of_partially_offloaded_row(tiny_server):
+    """Session failover: the exporting replica's row is PARTIALLY
+    offloaded; the re-ship must still carry the whole head, and the
+    importing store must serve it bitwise."""
+    from lambdipy_tpu.models.llama import arena_page_slices
+    from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+    cfg = tiny_server.model.cfg
+    pool_a = mk_pool(tiny_server, extra_pages=4)
+    store_a = PrefixStore(tiny_server, pool=pool_a)
+    store_a.attach_offload(OffloadArena(page=pool_a.page,
+                                        layers=cfg.layers))
+    row = _prompt(65, seed=10)
+    m = store_a.route(row)
+    _, before = store_a.export_blocks(row)
+    while store_a.reclaim_pages(1):
+        pass  # fully offloaded on A
+    assert store_a.check_invariants()["offloaded_blocks"] == m // BLOCK
+
+    head, blocks = store_a.export_blocks(row)
+    assert len(blocks) == m // BLOCK
+
+    with tiny_server._prefix_lock:
+        tiny_server._prefixes.clear()
+    pool_b = mk_pool(tiny_server, extra_pages=4)
+    store_b = PrefixStore(tiny_server, pool=pool_b)
+    out = store_b.import_blocks(head, blocks)
+    assert out["inserted"] == m // BLOCK
+    res = store_b.acquire_pages(head)
+    assert res is not None
+    pids, _ = res
+    with pool_b.arena_lock:
+        arena = pool_b.ensure_arena()
+    for pid, b in zip(pids, before):
+        assert _block_bytes(arena_page_slices(arena, pid, pool_b.page)) \
+            == _block_bytes(b)
+    pool_b.release(pids)
+    pool_b.check_invariants()
+
+
+def test_store_dropped_entries_degrade_to_recompute(tiny_server):
+    from lambdipy_tpu.runtime.prefixstore import PrefixStore
+
+    pool = mk_pool(tiny_server, extra_pages=4)
+    off = OffloadArena(page=pool.page,
+                       layers=tiny_server.model.cfg.layers)
+    store = PrefixStore(tiny_server, pool=pool)
+    store.attach_offload(off)
+    row = _prompt(65, seed=11)
+    m = store.route(row)
+    while store.reclaim_pages(1):
+        pass
+    off.drop(list(off._entries.keys()))  # the host tier lost the bytes
+    assert store.acquire_pages(row[:m]) is None  # dense fallback
+    assert off.stats.report()["recomputes"] >= 1
+    # the ghosts were pruned: the path re-prefills fresh and serves
+    assert store.route(row) == m
+    res = store.acquire_pages(row[:m])
+    assert res is not None
+    pool.release(res[0])
+    assert store.check_invariants()["ok"]
+    pool.check_invariants()
+
+
+# -- engine routing ------------------------------------------------------------
+
+
+def test_engine_routes_over_window_to_long_tier(tiny_server):
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    cfg = tiny_server.model.cfg
+    pool = mk_pool(tiny_server, n_windows=1, extra_pages=8)
+    eng = ContinuousBatcher(tiny_server, slots=2, segment=8,
+                            page_pool=pool,
+                            max_logical_ctx=8 * cfg.max_len)
+    row = _prompt(3 * cfg.max_len, seed=12)
+    out = eng.generate(row, max_new_tokens=12)
+    assert out.shape == (1, 12)
+    # streamed chunks concatenate to the non-streamed output
+    cat = np.concatenate(
+        list(eng.generate_stream(row, max_new_tokens=12)), axis=1)
+    assert np.array_equal(cat, out)
+    st = eng.stats()
+    assert st["long_context"]["max_logical_ctx"] == 8 * cfg.max_len
+    assert "kv_offload" in st["page_pool"]
+    # short rows keep the normal engine path, bitwise the solo server
+    short = row[:24]
+    assert np.array_equal(eng.generate(short, max_new_tokens=8),
+                          tiny_server.generate(short, max_new_tokens=8))
+
+
+def test_engine_long_tier_needs_paged_kv(tiny_server):
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    eng = ContinuousBatcher(tiny_server, slots=2, segment=8,
+                            max_logical_ctx=1024)
+    assert eng.max_logical_ctx == 0  # stood down loudly at boot
