@@ -148,7 +148,8 @@ def _spec_for(rng: random.Random, site: str, kind: str) -> str:
 
 def generate_timeline(*, seed: int, duration_s: float,
                       replicas: list[str], max_overlap: int = 3,
-                      extra_faults: int | None = None
+                      extra_faults: int | None = None,
+                      must_include: str | None = None
                       ) -> list[NemesisEvent]:
     """Derive a composed-fault schedule from ``seed``.
 
@@ -278,6 +279,38 @@ def generate_timeline(*, seed: int, duration_s: float,
             continue
         add_fault(t0, hold, target, site, kind)
         placed += 1
+
+    # 5. the guaranteed must_include site (when asked): a soak composing
+    # a SPECIFIC failure mode (offload_stall on a paged+offload fleet,
+    # say) needs at least one armed leg of that site in EVERY seed's
+    # schedule, not just the seeds whose random draws happened to pick
+    # it. Drawn AFTER the extras, so must_include=None timelines stay
+    # byte-identical to every seed generated before the knob existed.
+    if must_include is not None:
+        cands = [m for m in menu if m[1] == must_include]
+        if not cands:
+            raise ValueError(
+                f"must_include site {must_include!r} offers no menu "
+                f"legs (unknown site, or no eligible target)")
+        if not any(e.action == "arm"
+                   and e.spec.partition(":")[0] == must_include
+                   for e in events):
+            for _ in range(128):
+                target, site, kind = cands[rng.randrange(len(cands))]
+                t0 = rng.uniform(lo, hi)
+                hold = rng.uniform(*FAULT_HOLD_S)
+                if t0 + hold > 0.9 * duration_s:
+                    continue
+                if not alive(t0, t0 + hold, target):
+                    continue
+                if not overlap_ok(t0, t0 + hold, target):
+                    continue
+                add_fault(t0, hold, target, site, kind)
+                break
+            else:
+                raise ValueError(
+                    f"could not place the must_include "
+                    f"{must_include!r} event inside the soak window")
 
     events.sort(key=lambda e: (e.t, e.action, e.target))
     return events

@@ -178,8 +178,12 @@ class SoakFleet:
             # failure never surfaces as a client 500
             "LAMBDIPY_MAX_REPLAYS": "3",
         }
+        # the paged replica also runs the host offload tier: the
+        # offload_stall legs the timeline guarantees (must_include)
+        # need an arena attached to fire for real, not arm a no-op
         env_paged = dict(env_base, LAMBDIPY_KV_PAGED="1",
-                         LAMBDIPY_KV_PAGES="64")
+                         LAMBDIPY_KV_PAGES="64",
+                         LAMBDIPY_KV_OFFLOAD="1")
         self.rt = LocalRuntime(self.tmp / "deployments.json")
         self.router_plan = FaultPlan.empty()
         self.pool = ReplicaPool(probe_interval=0.4, fail_threshold=2,
@@ -370,7 +374,8 @@ def run_window(fleet: SoakFleet, *, seed: int, duration_s: float,
     generated = timeline is None
     if generated:
         timeline = generate_timeline(seed=seed, duration_s=duration_s,
-                                     replicas=list(REPLICAS))
+                                     replicas=list(REPLICAS),
+                                     must_include="offload_stall")
     props = timeline_properties(timeline)
     sids = sorted(plan.sessions)
     expiry_sid = sids[0] if sids else None

@@ -524,6 +524,16 @@ def deploy_cmd(bundle, name, port, registry_dir, timeout, watchdog):
                    "ring-attention path (parallel/ring.py) when the "
                    "mesh has an sp axis; without one the knob stands "
                    "down counted, never silently")
+@click.option("--prefill-mode", type=click.Choice(["chunked", "sp"]),
+              default=None,
+              help="cold-prefill schedule: 'chunked' (default) runs the "
+                   "serial chunk chain; 'sp' runs the whole prompt as "
+                   "sequence-parallel rounds over the mesh's sp axis — "
+                   "ONE sharded program per round, ~1/sp the TTFT "
+                   "critical path. Without an sp mesh axis the knob "
+                   "stands down counted, never silently. Live-retunable "
+                   "via /v1/debug/knobs; counters ride /metrics under "
+                   "batching.prefill")
 @click.option("--spec-k", type=int, default=None,
               help="speculative decoding inside the continuous engine: "
                    "each segment drafts up to K-1 tokens per row by "
@@ -562,7 +572,8 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
               prefix_block, session_pin_budget, session_ttl,
               pipeline_depth, engine_watchdog, kv_paged,
               kv_pages, max_logical_ctx, kv_offload, kv_offload_mb,
-              long_prefill, spec_k, draft_mode, draft_exit, mesh_spec):
+              long_prefill, prefill_mode, spec_k, draft_mode, draft_exit,
+              mesh_spec):
     """Serve a bundle in the foreground."""
     from lambdipy_tpu.runtime.server import BundleServer
 
@@ -594,6 +605,8 @@ def serve_cmd(bundle, port, registry_dir, sched_policy, sched_concurrency,
         os.environ["LAMBDIPY_KV_OFFLOAD_MB"] = str(kv_offload_mb)
     if long_prefill is not None:
         os.environ["LAMBDIPY_LONG_PREFILL"] = "1" if long_prefill else "0"
+    if prefill_mode is not None:
+        os.environ["LAMBDIPY_PREFILL_MODE"] = prefill_mode
     if spec_k is not None:
         os.environ["LAMBDIPY_SPEC_K"] = str(spec_k)
     if draft_mode is not None:

@@ -138,6 +138,16 @@ class FleetController:
             # the policy's draft_mode demote rule watches
             draft = spec.get("draft") or {}
             dprov = ((draft.get("providers") or {}).get("model") or {})
+            # long-context tier (batching.long_context): re-online
+            # stall seconds over engine-busy wall (pipeline.wall_s) is
+            # the thrash signal the max_logical_ctx retune watches;
+            # absent block -> all None
+            lc = batching.get("long_context") or {}
+            stall = lc.get("stall_s")
+            stall_frac = None
+            if isinstance(wall, (int, float)) and wall > 0 \
+                    and isinstance(stall, (int, float)):
+                stall_frac = float(stall) / float(wall)
             views.append(ReplicaView(
                 name=name, role=role, routable=routable, managed=managed,
                 outstanding=int(outstanding),
@@ -148,6 +158,11 @@ class FleetController:
                 acceptance=spec.get("acceptance_rate"),
                 draft_mode=spec.get("draft_mode"),
                 draft_acceptance=dprov.get("acceptance_ewma"),
+                offload_stall_frac=stall_frac,
+                prefetch_hit_rate=lc.get("prefetch_hit_rate"),
+                max_logical_ctx=lc.get("max_logical_ctx"),
+                compiled_window=lc.get("window"),
+                boot_logical_ctx=lc.get("boot_logical_ctx"),
             ))
         return Snapshot(
             t=round(float(t), 3),

@@ -83,6 +83,15 @@ class ReplicaView:
     # demote rule watches for a collapsed self-draft head
     draft_mode: str | None = None
     draft_acceptance: float | None = None
+    # long-context tier (batching.long_context): the re-online stall
+    # share of engine wall and the decode-cursor prefetch hit rate
+    # drive the max_logical_ctx retune; the compiled window bounds it
+    # below, the boot-time cap bounds the restore above
+    offload_stall_frac: float | None = None
+    prefetch_hit_rate: float | None = None
+    max_logical_ctx: int | None = None
+    compiled_window: int | None = None
+    boot_logical_ctx: int | None = None
 
 
 @dataclass(frozen=True)
@@ -149,6 +158,16 @@ class PolicyConfig:
     ship_window_max: int = 16
     ship_ms_high: float = 50.0
     ship_ms_low: float = 5.0
+    # max_logical_ctx: halve the admitted logical window while
+    # re-online stalls eat a visible share of engine wall (the offload
+    # tier is thrashing — rows slide more history than the host arena
+    # can re-online in time), double it back toward the boot cap on
+    # clean windows. The band (high/low) plus the per-knob cooldown is
+    # the damping; the compiled window is the hard floor (below it the
+    # runner cannot serve at all).
+    stall_frac_high: float = 0.10
+    stall_frac_low: float = 0.02
+    prefetch_hit_floor: float = 0.5
 
 
 @dataclass
@@ -346,6 +365,35 @@ def _knobs(snap: Snapshot, state: PolicyState,
             emit(r.name, "draft_mode", "lookup",
                  f"draft acceptance {r.draft_acceptance:.2f} < "
                  f"{cfg.draft_acceptance_floor:.2f}")
+        # max_logical_ctx from the offload tier's own stall accounting:
+        # step DOWN (halve, floored at the compiled window) while
+        # re-online stalls are a sustained share of wall — or while the
+        # prefetcher is missing most demands and stalls are already
+        # above the clean band; step back UP (double, capped at the
+        # boot value) once the window runs clean. The replica publishes
+        # nothing without a live long-context runner — rule skipped.
+        if r.max_logical_ctx is not None \
+                and r.compiled_window is not None \
+                and r.compiled_window > 0 \
+                and r.offload_stall_frac is not None:
+            boot = r.boot_logical_ctx or r.max_logical_ctx
+            thrash = r.offload_stall_frac > cfg.stall_frac_high or (
+                r.prefetch_hit_rate is not None
+                and r.prefetch_hit_rate < cfg.prefetch_hit_floor
+                and r.offload_stall_frac > cfg.stall_frac_low)
+            if thrash and r.max_logical_ctx > r.compiled_window:
+                hit = ("n/a" if r.prefetch_hit_rate is None
+                       else f"{r.prefetch_hit_rate:.2f}")
+                emit(r.name, "max_logical_ctx",
+                     max(r.compiled_window, r.max_logical_ctx // 2),
+                     f"reonline stall {r.offload_stall_frac:.3f} of "
+                     f"wall, prefetch hit {hit}")
+            elif r.offload_stall_frac < cfg.stall_frac_low \
+                    and r.max_logical_ctx < boot:
+                emit(r.name, "max_logical_ctx",
+                     min(boot, r.max_logical_ctx * 2),
+                     f"reonline stall {r.offload_stall_frac:.3f} of "
+                     f"wall (clean)")
     # the router's ship window from the ship-latency EWMA — only once
     # real ships have priced the transport
     if snap.ships > 0 and snap.ship_window > 0:

@@ -243,6 +243,24 @@ def _active_sp_mesh():
     return None
 
 
+def resolve_sp_prefill(mode: str, mesh) -> int:
+    """Resolve the usable whole-prompt sp-prefill factor for
+    ``prefill_mode``: 0 under ``chunked``; under ``sp`` the mesh's
+    sp-axis size when >= 2, else 0 with a counted stand-down
+    (``sp_prefill_without_sp_mesh``) — the ``spec_k_under_sp_mesh``
+    idiom: the operator's ask is impossible on this mesh, so the serial
+    path runs and the condition is visible on /metrics, never silent."""
+    if mode != "sp":
+        return 0
+    sp = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
+    if sp >= 2:
+        return sp
+    from lambdipy_tpu.parallel.spdecode import note_standdown
+
+    note_standdown("sp_prefill_without_sp_mesh")
+    return 0
+
+
 def _attend(q, k, v, mask):
     """Grouped-query attention core. q: [b,s,h,d]; k/v: [b,t,kvh,d].
 
@@ -272,12 +290,18 @@ def _attend(q, k, v, mask):
 class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
-    def _prefill_attend(self, q, k, v, mask):
-        """Causal prefill attention via the configured backend."""
+    def _prefill_attend(self, q, k, v, mask, sp_prefill: int = 0):
+        """Causal prefill attention via the configured backend.
+
+        ``sp_prefill >= 2`` requests the whole-prompt sequence-parallel
+        tier regardless of the configured backend: the first chunk of an
+        sp-prefill program ring-shards the full prompt's attention over
+        the sp axis. Falls through to the configured backend when no
+        usable sp mesh exists (the caller counts the stand-down)."""
         cfg = self.cfg
         s = q.shape[1]
         backend = cfg.attn_backend
-        if backend == "ring":
+        if backend == "ring" or sp_prefill >= 2:
             from lambdipy_tpu.parallel.ring import ring_attention
 
             mesh = _active_sp_mesh()
@@ -287,7 +311,7 @@ class LlamaBlock(nn.Module):
                 # batches match the dense backend exactly
                 return ring_attention(q, k, v, mesh, causal=True,
                                       kv_mask=mask)
-            backend = "dense"  # no usable sp axis -> fall through
+            backend = cfg.attn_backend if backend != "ring" else "dense"
         if backend == "flash":
             from lambdipy_tpu.ops.attention import flash_attention
 
@@ -297,9 +321,22 @@ class LlamaBlock(nn.Module):
         return _attend(q, k, v, attn_mask)
 
     @nn.compact
-    def __call__(self, x, positions, mask, cache):
+    def __call__(self, x, positions, mask, cache, sp_prefill: int = 0,
+                 band: int = 0):
         """cache: None (prefill over full x) or dict(k, v, index) for decode.
-        Returns (y, new_cache_entry)."""
+        Returns (y, new_cache_entry).
+
+        sp_prefill: static int — when >= 2, this is a whole-prompt
+        sequence-parallel prefill program: the no-cache branch
+        ring-shards the prompt's attention, the scalar-index
+        continuation branch (s > 1) shards the chunk's queries over the
+        sp axis (:func:`sp_chunk_attention`). 0 keeps every existing
+        program byte-identical.
+        band: static int — when > 0, restrict each scalar-index query at
+        cache position p to keys in [max(0, (p//band - 1)*band), p]: the
+        long-context SLIDING-WINDOW band, so one multi-chunk sp round
+        attends exactly what the serial window/2 slide schedule would
+        have exposed chunk by chunk."""
         cfg = self.cfg
         d = cfg.head_dim
         h = RMSNorm(cfg.norm_eps, name="attn_norm")(x)
@@ -313,7 +350,7 @@ class LlamaBlock(nn.Module):
         q, k = rope(q, k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         if cache is None:
-            out = self._prefill_attend(q, k, v, mask)
+            out = self._prefill_attend(q, k, v, mask, sp_prefill)
             new_cache = {"k": k, "v": v}
         else:
             from lambdipy_tpu.parallel.sharding import shard_hint
@@ -379,6 +416,17 @@ class LlamaBlock(nn.Module):
                     t = new_cache[next(iter(store))].shape[1]
                     valid = (jnp.arange(t)[None, None, :]
                              <= (idx + jnp.arange(s))[None, :, None])
+                    if band:
+                        # long-context sliding band: query at cache
+                        # position p sees keys from the start of the
+                        # PREVIOUS band block — exactly the window the
+                        # serial window/2 slide schedule leaves resident
+                        # when p's chunk runs
+                        qpos = idx + jnp.arange(s)
+                        band_start = jnp.maximum(
+                            0, (qpos // band - 1) * band)
+                        valid = valid & (jnp.arange(t)[None, None, :]
+                                         >= band_start[None, :, None])
                 else:
                     # ragged batch (rows decode from different prompt
                     # lengths): per-row scatter of this step's (or
@@ -436,7 +484,22 @@ class LlamaBlock(nn.Module):
                     else:
                         ck, cv = new_cache["k"], new_cache["v"]
                     attn_mask = jnp.broadcast_to(valid, (b, s, t))
-                    out = _attend(q, ck, cv, attn_mask)
+                    sp_mesh = (_active_sp_mesh()
+                               if (sp_prefill >= 2 and s > 1
+                                   and jnp.ndim(idx) == 0
+                                   and s % sp_prefill == 0) else None)
+                    if sp_mesh is not None:
+                        # sp-prefill continuation chunk: queries shard
+                        # over sp, the cache stays replicated (as decode
+                        # keeps it) — score memory and the softmax walk
+                        # split across the mesh, no per-layer collective
+                        from lambdipy_tpu.parallel.ring import (
+                            sp_chunk_attention)
+
+                        out = sp_chunk_attention(q, ck, cv, attn_mask,
+                                                 sp_mesh)
+                    else:
+                        out = _attend(q, ck, cv, attn_mask)
 
         out = out.reshape(b, s, cfg.heads * d)
         x = x + QDense(cfg.hidden, cfg.quant, cfg.dtype, cfg.matmul_backend, name="o_proj")(out)
@@ -461,7 +524,8 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, mask=None, cache=None,
-                 logit_positions=None, exit_layer=None):
+                 logit_positions=None, exit_layer=None, sp_prefill=0,
+                 band=0):
         """Returns (logits, new_cache).
 
         prefill: cache=None, tokens [b, s] -> cache entries sized s.
@@ -492,7 +556,9 @@ class LlamaModel(nn.Module):
         new_cache = []
         for i in range(n_layers):
             layer_cache = None if cache is None else cache[i]
-            x, c = LlamaBlock(cfg, name=f"layer_{i}")(x, positions, mask, layer_cache)
+            x, c = LlamaBlock(cfg, name=f"layer_{i}")(
+                x, positions, mask, layer_cache, sp_prefill=sp_prefill,
+                band=band)
             new_cache.append(c)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if logit_positions is not None:
@@ -1048,7 +1114,7 @@ def _serve_select(temperature, top_k, top_p):
 
 
 def _serve_prefill(model: LlamaModel, params, prompt, length, select, rng,
-                   eos_id, *, cache_len: int):
+                   eos_id, *, cache_len: int, sp_prefill: int = 0):
     """Bucketed serving prefill: embed the prompt into a ``cache_len``
     decode cache and select the first token. Returns the decode carry
     ``(first, lp0, cache, pos, done, rng)`` consumed by
@@ -1061,7 +1127,8 @@ def _serve_prefill(model: LlamaModel, params, prompt, length, select, rng,
     # lm_head only at each row's last real position: [b, 1, v], never the
     # [b, sb, v] full-prefill logits tensor
     logits, prefill_cache = model.apply(params, prompt,
-                                        logit_positions=length - 1)
+                                        logit_positions=length - 1,
+                                        sp_prefill=sp_prefill)
     cache = prefill_into_cache(cfg, prefill_cache, b, cache_len, 0)
     for entry in cache:
         entry["index"] = length
@@ -1072,7 +1139,8 @@ def _serve_prefill(model: LlamaModel, params, prompt, length, select, rng,
 
 
 def _continue_prefill(model: LlamaModel, params, cache, suffix, suffix_len,
-                      select, rng, eos_id, sbs: int, pos_offset=None):
+                      select, rng, eos_id, sbs: int, pos_offset=None,
+                      sp_prefill: int = 0, band: int = 0):
     """Continuation prefill from a cached prefix KV: embed the suffix
     chunk at positions after the cache index, select the first token, and
     return the decode carry ``(first, lp0, cache, pos, done, rng)``. The
@@ -1087,7 +1155,8 @@ def _continue_prefill(model: LlamaModel, params, cache, suffix, suffix_len,
     positions = (rope0 + jnp.arange(sbs))[None, :]
     logits, new_cache = model.apply(
         params, suffix, positions=positions, cache=cache,
-        logit_positions=jnp.broadcast_to(suffix_len - 1, (1,)))
+        logit_positions=jnp.broadcast_to(suffix_len - 1, (1,)),
+        sp_prefill=sp_prefill, band=band)
     # The carry must come out in the SEG-PROGRAM family's shapes: per-row
     # (1,) index/pos, matching what _serve_prefill produces. The prefix
     # cache's scalar index fed model.apply above (the multi-token chunk
@@ -1914,7 +1983,97 @@ class LlamaServer:
 
         return self._fn_cached(("prefix_ext", sbs), build)
 
-    def _chunked_prefill_cache(self, row, upto: int, cache_len: int):
+    def _sp_first_fn(self, sb: int, cache_len: int, sp: int):
+        """Whole-prompt sequence-parallel first chunk: ONE sharded
+        program embeds the (padded) round into a full-window cache with
+        the prompt's attention ring-sharded over the sp axis
+        (``sp_prefill=sp`` routes the no-cache branch through
+        :func:`~lambdipy_tpu.parallel.ring.ring_attention`). For a
+        prompt that fits one round this IS the cold prefill — one
+        program, critical path 1/sp of the chunk chain."""
+        if sb % sp:
+            raise ValueError(f"sp first-chunk width {sb} % sp={sp} != 0")
+
+        def build():
+            def pf(params, prompt, length):
+                _, prefill_cache = self.model.apply(
+                    params, prompt,
+                    logit_positions=jnp.zeros((1,), jnp.int32),
+                    sp_prefill=sp)
+                cache = prefill_into_cache(self.model.cfg, prefill_cache, 1,
+                                           cache_len, 0)
+                for entry in cache:
+                    entry["index"] = length  # int32 scalar
+                return cache
+
+            return jax.jit(pf)
+
+        return self._fn_cached(("sp_prefill", 1, sb // sp, cache_len, sp),
+                               build)
+
+    def _sp_ext_fn(self, sbs: int, sp: int):
+        """Sequence-parallel twin of :meth:`_prefix_ext_fn`: extend the
+        cache by one ROUND of ``sp`` chunk-widths in a single program —
+        the round's queries shard over the sp axis
+        (:func:`~lambdipy_tpu.parallel.ring.sp_chunk_attention`), the
+        cache write and index math are byte-identical to the serial
+        ext's (same scalar-index branch, same padded-chunk contract:
+        only the last round may be ragged)."""
+        if sbs % sp:
+            raise ValueError(f"sp round width {sbs} % sp={sp} != 0")
+
+        def build():
+            def ext(params, cache, chunk, chunk_len):
+                idx = cache[0]["index"].reshape(())
+                cache = [{**c, "index": idx} for c in cache]
+                positions = (idx + jnp.arange(sbs))[None, :]
+                _, new_cache = self.model.apply(
+                    params, chunk, positions=positions, cache=cache,
+                    logit_positions=jnp.zeros((1,), jnp.int32),
+                    sp_prefill=sp)
+                for entry in new_cache:
+                    entry["index"] = idx + chunk_len
+                return new_cache
+
+            return jax.jit(ext, donate_argnums=(1,))
+
+        return self._fn_cached(("sp_prefill_ext", 1, sbs // sp, sp), build)
+
+    def _sp_prefill_cache(self, row, upto: int, cache_len: int, sp: int,
+                          stats=None):
+        """Whole-prompt sequence-parallel cold prefill: embed
+        ``row[:upto]`` through rounds of ``sp * prefill_chunk`` tokens —
+        each round ONE sharded program — instead of the serial chunk
+        chain. ceil(upto / (sp*ck)) program dispatches on the TTFT
+        critical path where the chunked walk pays ceil(upto / ck).
+        Caller holds the mesh context (the programs shard over its sp
+        axis) and has resolved ``sp`` via :func:`resolve_sp_prefill`."""
+        ck = self.prefill_chunk
+        rk = max(ck * sp, sp)
+        layers = self.model.cfg.layers
+        first = min(rk, upto)
+        sb = min(_next_bucket(max(first, sp), self.min_bucket * sp),
+                 cache_len)
+        pf_fn = self._sp_first_fn(sb, cache_len, sp)
+        prompt_op, _ = self._pad_rows([row[:first]], [first], 1, sb)
+        cache = pf_fn(self.params, prompt_op, jnp.int32(first))
+        if stats is not None:
+            stats.record_round(-(-first // max(ck, 1)), sp,
+                               ring_hops=layers * sp)
+        pos = first
+        if pos < upto:
+            ext = self._sp_ext_fn(rk, sp)
+            while pos < upto:
+                n = min(rk, upto - pos)
+                chunk_op, _ = self._pad_rows([row[pos:pos + n]], [n], 1, rk)
+                cache = ext(self.params, cache, chunk_op, jnp.int32(n))
+                if stats is not None:
+                    stats.record_round(-(-n // max(ck, 1)), sp)
+                pos += n
+        return cache
+
+    def _chunked_prefill_cache(self, row, upto: int, cache_len: int,
+                               sp: int = 0, stats=None):
         """Embed ``row[:upto]`` into a fresh ``cache_len`` KV cache
         through the fixed-width chunk programs (first + ext): bounded
         attention memory (O(ck x s), not O(s^2)) and O(1) compiled
@@ -1923,17 +2082,28 @@ class LlamaServer:
         behind the cache index). The ONE chunk-walk shared by the
         prefix cache and the continuous engine's chunked joiner
         prefill — the donation-sensitive ext loop must not fork.
-        Caller holds the mesh context."""
+        Caller holds the mesh context.
+
+        ``sp >= 2`` (resolved via :func:`resolve_sp_prefill`) takes the
+        whole-prompt sequence-parallel walk instead: same cache result
+        (token-for-token), 1/sp the serial program chain."""
+        if sp >= 2:
+            return self._sp_prefill_cache(row, upto, cache_len, sp,
+                                          stats=stats)
         ck = self.prefill_chunk
         pf_fn = self._prefix_first_fn(ck, cache_len)
         prompt_op, _ = self._pad_rows([row[:ck]], [ck], 1, ck)
         cache = pf_fn(self.params, prompt_op, jnp.int32(ck))
+        if stats is not None:
+            stats.record_round(1, 1)
         ext = self._prefix_ext_fn(ck)
         pos = ck
         while pos < upto:
             n = min(ck, upto - pos)
             chunk_op, _ = self._pad_rows([row[pos:pos + n]], [n], 1, ck)
             cache = ext(self.params, cache, chunk_op, jnp.int32(n))
+            if stats is not None:
+                stats.record_round(1, 1)
             pos += n
         return cache
 
@@ -2018,19 +2188,24 @@ class LlamaServer:
             return toks, np.asarray(jax.device_get(lps))[:, :max_new_tokens]
         return toks
 
-    def _stream_fns(self, b: int, sb: int, cache_len: int, segment: int):
+    def _stream_fns(self, b: int, sb: int, cache_len: int, segment: int,
+                    sp_prefill: int = 0):
         """Compiled (prefill, segment) pair for streaming. The prefill
         program returns the decode carry; each segment program advances it
         ``segment`` tokens and returns (tokens, carry). Cached like the
         fused programs, so streaming adds at most two programs per
-        bucket."""
+        bucket. ``sp_prefill >= 2`` keys a variant whose prefill member
+        ring-shards the prompt's attention over the sp axis (the
+        continuous engine's sharded GROUP prefill); the segment member
+        is byte-identical to the serial pair's."""
         def build():
             def prefill(params, prompt, length, temperature, top_k, top_p,
                         rng, eos_id):
                 select = _serve_select(temperature, top_k, top_p)
                 return _serve_prefill(self.model, params, prompt, length,
                                       select, rng, eos_id,
-                                      cache_len=cache_len)
+                                      cache_len=cache_len,
+                                      sp_prefill=sp_prefill)
 
             def seg(params, temperature, top_k, top_p, first, lp, cache,
                     pos, done, rng, eos_id):
@@ -2041,7 +2216,9 @@ class LlamaServer:
 
             return (jax.jit(prefill), jax.jit(seg))
 
-        return self._fn_cached(("stream", b, sb, cache_len, segment), build)
+        key = (("stream", b, sb, cache_len, segment) if not sp_prefill
+               else ("stream", b, sb, cache_len, segment, sp_prefill))
+        return self._fn_cached(key, build)
 
     def _windowed_seg_fn(self, b: int, cache_len: int, window: int,
                          segment: int):
@@ -2452,6 +2629,44 @@ class LlamaServer:
 
         return self._fn_cached(("lpcont", sbs, n_pages, page, window),
                                build)
+
+    def _lsp_round_fn(self, n_chunks: int, n_pages: int, page: int,
+                      window: int, sp: int):
+        """Paged twin of the whole-prompt sp-prefill family for the
+        long-context tier: ONE sharded program runs ``n_chunks`` of the
+        serial window/2 slide schedule as a single ROUND. The gathered
+        UNION view holds the prior half-window (``prior_len`` tokens —
+        0 on round 0) followed by the round's ``n_chunks * window/2``
+        tokens; ``band = window/2`` restricts every query to exactly the
+        keys its serial chunk would have seen resident, RoPE sees
+        logical positions via ``base``, and the written KV scatters
+        straight back into the arena pages (prior pages come back
+        bitwise-unchanged, the validated ``_page_write_fn``-shaped
+        per-page layout). The S/(window/2) serial chain collapses to
+        ceil(S / (sp * window/2)) rounds."""
+        w2 = window // 2
+        rbs = n_chunks * w2       # round token width
+        uw = (n_chunks + 1) * w2  # union view: prior half-window + round
+
+        def build():
+            def rnd(params, arena, table, prior_len, base, chunk,
+                    round_len, temperature, top_k, top_p, rng, eos_id):
+                select = _serve_select(temperature, top_k, top_p)
+                cache = _gather_page_cache(arena, table, uw, page,
+                                           prior_len)
+                first, lp0, new_cache, start, done0, keys = \
+                    _continue_prefill(self.model, params, cache, chunk,
+                                      round_len, select, rng, eos_id,
+                                      rbs, pos_offset=base - prior_len,
+                                      sp_prefill=sp, band=w2)
+                new_arena = _scatter_page_cache(arena, table, new_cache,
+                                                page)
+                return first, lp0, new_arena, start, done0, keys
+
+            return jax.jit(rnd)
+
+        return self._fn_cached(
+            ("sp_pprefill", n_chunks, n_pages, page, window, sp), build)
 
     def _paged_gather_fn(self, n_pages: int, page: int, window: int):
         """Read-only page gather -> contiguous single-row cache (index

@@ -106,6 +106,76 @@ def _ring_attention_local(q, k, v, km=None, *, axis_name: str, causal: bool,
     return out.astype(q.dtype)
 
 
+def _sp_chunk_local(q, k, v, mask, *, nblocks: int, scale: float,
+                    vary_axes: tuple[str, ...]):
+    """Per-shard body for :func:`sp_chunk_attention` (runs inside
+    shard_map). q: [b, sq_local, h, d]; k/v: [b, t, h, d] (the FULL,
+    replicated cache); mask: [b, sq_local, t] bool. The key axis is
+    walked in ``nblocks`` blocks through the same ``_block_attend`` /
+    ``_combine`` online-softmax pair the ring path uses, so the combine
+    math is block-exact and per-shard score memory is
+    (sq/sp) x ceil(t/nblocks), never the full (sq x t) sheet."""
+    from lambdipy_tpu.parallel.mesh import pcast_varying
+
+    b, sq, h, d = q.shape
+    t = k.shape[1]
+    m = pcast_varying(jnp.full((b, h, sq), NEG_INF, jnp.float32), vary_axes)
+    l = pcast_varying(jnp.zeros((b, h, sq), jnp.float32), vary_axes)
+    acc = pcast_varying(jnp.zeros((b, sq, h, d), jnp.float32), vary_axes)
+    kb = -(-t // nblocks)  # ceil
+    for i in range(nblocks):
+        lo = i * kb
+        hi = min(t, lo + kb)
+        if lo >= hi:
+            break
+        bm, bl, bacc = _block_attend(q, k[:, lo:hi], v[:, lo:hi],
+                                     mask[:, None, :, lo:hi], scale)
+        m, l, acc = _combine(m, l, acc, bm, bl, bacc)
+    l = jnp.maximum(l, 1e-30)
+    out = acc / jnp.transpose(l, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def sp_chunk_attention(q, k, v, mask, mesh: Mesh, *, axis: str = "sp",
+                       scale: float | None = None):
+    """Sequence-parallel prefill-CHUNK attention: the chunk's queries are
+    sharded over ``axis`` while the full K/V cache (prefix + this chunk,
+    already written at the cache index) stays replicated — each shard
+    owns s/sp query rows and attends the whole key range under the
+    caller's validity mask. This is the continuation-chunk member of the
+    whole-prompt sp-prefill family: the first chunk has no cache and
+    ring-shards both operands (:func:`ring_attention`); every later
+    chunk reads a cache that decode keeps replicated anyway, so only the
+    query/score side shards and no collective is needed beyond the
+    out-spec gather.
+
+    q: [b, s, h, d] with ``s`` divisible by the ``axis`` size;
+    k/v: [b, t, kvh, d]; mask: [b, s, t] bool (True = attend).
+    """
+    h, kvh = q.shape[2], k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    sp = mesh.shape[axis]
+    if q.shape[1] % sp:
+        raise ValueError(
+            f"sp_chunk_attention: chunk width {q.shape[1]} not divisible "
+            f"by {axis}={sp}")
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    bspec = batch_axes if batch_axes else None
+    qspec = P(bspec, axis, None, None)
+    kspec = P(bspec, None, None, None)
+    mspec = P(bspec, axis, None)
+    local = partial(_sp_chunk_local, nblocks=sp, scale=scale,
+                    vary_axes=batch_axes + (axis,))
+    fn = shard_map_compat(local, mesh=mesh,
+                          in_specs=(qspec, kspec, kspec, mspec),
+                          out_specs=qspec)
+    return fn(q, k, v, mask)
+
+
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
                    causal: bool = True, scale: float | None = None,
                    kv_mask=None):

@@ -197,12 +197,14 @@ class ContinuousBatcher:
                  draft_mode: str = "lookup", draft_exit: int = 1,
                  draft_provider: Any = None,
                  max_logical_ctx: int = 0,
-                 long_prefill: bool = False):
+                 long_prefill: bool = False,
+                 prefill_mode: str = "chunked"):
         import jax
 
         from lambdipy_tpu.runtime.metrics import (DecodeWindowStats,
                                                   EngineFaultStats,
                                                   PipelineStats,
+                                                  PrefillStats,
                                                   SpecDecodeStats)
 
         self.server = server
@@ -398,7 +400,22 @@ class ContinuousBatcher:
         # one the knob stands down loudly at construction, not at the
         # first routed request.
         self.max_logical_ctx = max(0, int(max_logical_ctx or 0))
+        # the compiled window is the retune FLOOR for the fleet
+        # controller's max_logical_ctx rule; the boot value is its
+        # restore CEILING — both published under batching.long_context
+        self.max_logical_ctx_boot = self.max_logical_ctx
         self.long_prefill = bool(long_prefill)
+        # -- whole-prompt sequence-parallel prefill (prefill_mode knob) ------
+        # "chunked" keeps every cold prefill the serial chunk chain;
+        # "sp" collapses it to rounds of sp chunk-widths, each ONE
+        # sharded program (models/llama.py sp_prefill family). Resolved
+        # against the server's mesh here and re-resolved on live retune
+        # (/v1/debug/knobs); sp without an sp mesh axis stands down with
+        # a counted reason, exactly like spec_k_under_sp_mesh.
+        self.prefill_stats = PrefillStats()
+        self.prefill_mode = "chunked"
+        self.prefill_sp = 0
+        self.set_prefill_mode(prefill_mode)
         self._longctx: Any = None     # built lazily on first routed row
         self._longctx_lock = threading.Lock()
         if self.max_logical_ctx and page_pool is None:
@@ -460,6 +477,27 @@ class ContinuousBatcher:
         # prefix= or the automatic radix store): suffix-only
         # continuation carries packed into the shared batch
         self.prefix_joins = 0
+
+    def set_prefill_mode(self, mode) -> str:
+        """Resolve + apply the ``prefill_mode`` knob (``chunked`` |
+        ``sp``). Live-retunable: the next cold prefill picks up the new
+        schedule (program families are cached per (width, sp), so
+        flipping back and forth costs nothing after first compile).
+        ``sp`` without a usable sp mesh axis stands down to chunked with
+        the counted ``sp_prefill_without_sp_mesh`` reason."""
+        from lambdipy_tpu.models.llama import resolve_sp_prefill
+
+        mode = str(mode or "chunked").lower()
+        if mode not in ("chunked", "sp"):
+            raise ValueError(
+                f"prefill_mode must be 'chunked' or 'sp', got {mode!r}")
+        sp = resolve_sp_prefill(mode, getattr(self.server, "mesh", None))
+        self.prefill_mode = mode
+        self.prefill_sp = sp
+        if mode == "sp" and not sp:
+            self.prefill_stats.record_standdown("sp_prefill_without_sp_mesh")
+        self.prefill_stats.configure(mode, sp)
+        return mode
 
     # -- device helpers ------------------------------------------------------
 
@@ -678,7 +716,13 @@ class ContinuousBatcher:
         server = self.server
         sb = max(s, min(_next_bucket(s, server.min_bucket),
                         self.cache_len))
-        prefill, _ = server._stream_fns(1, sb, self.cache_len, self.segment)
+        sp = self.prefill_sp if (self.prefill_sp >= 2
+                                 and sb % self.prefill_sp == 0) else 0
+        prefill, _ = server._stream_fns(1, sb, self.cache_len, self.segment,
+                                        sp_prefill=sp)
+        if sp:
+            self.prefill_stats.record_round(
+                1, sp, ring_hops=server.model.cfg.layers * sp)
         prompt_op, length_op = server._pad_rows([row], [s], 1, sb)
         knobs = server._knob_operands(
             entry["temperature"], entry["top_k"], entry["top_p"],
@@ -702,8 +746,16 @@ class ContinuousBatcher:
         bb = _next_bucket(len(rows), 1)
         sb = max(max(lens), min(_next_bucket(max(lens), server.min_bucket),
                                 self.cache_len))
+        # sharded group prefill: the ONE ragged b-row program ring-shards
+        # its prompt attention over the sp axis — same program count,
+        # 1/sp the attention critical path per group
+        sp = self.prefill_sp if (self.prefill_sp >= 2
+                                 and sb % self.prefill_sp == 0) else 0
         prefill, _ = server._stream_fns(bb, sb, self.cache_len,
-                                        self.segment)
+                                        self.segment, sp_prefill=sp)
+        if sp:
+            self.prefill_stats.record_round(
+                1, sp, ring_hops=server.model.cfg.layers * sp)
         prompt_op, length_op = server._pad_rows(rows, lens, bb, sb)
         knobs = server._knob_operands(
             [e["temperature"] for e in entries],
@@ -793,8 +845,15 @@ class ContinuousBatcher:
             return self._prefill_row(row, s, entry)
         tail = row[split:]
         with server._mesh_ctx():
-            cache = server._chunked_prefill_cache(row, split,
-                                                  self.cache_len)
+            t0 = time.monotonic()
+            cache = server._chunked_prefill_cache(
+                row, split, self.cache_len, sp=self.prefill_sp,
+                stats=self.prefill_stats)
+            sp = self.prefill_sp
+            n_chunks = -(-split // ck)
+            n_rounds = -(-split // (ck * sp)) if sp >= 2 else n_chunks
+            self.prefill_stats.record_walk(
+                time.monotonic() - t0, n_chunks, n_rounds)
             sbs = min(_next_bucket(len(tail), server.min_bucket),
                       self.cache_len - split)
             # a full-window engine shares the prefix path's continuation
@@ -2159,7 +2218,9 @@ class ContinuousBatcher:
                         max_logical_ctx=self.max_logical_ctx,
                         long_prefill=self.long_prefill,
                         faults=self.faults,
-                        max_replays=max(1, self.max_replays))
+                        max_replays=max(1, self.max_replays),
+                        prefill_mode=self.prefill_mode,
+                        prefill_stats=self.prefill_stats)
                 except Exception as e:  # noqa: BLE001 — stand down, keep serving
                     log.error("long-context runner unavailable (knob "
                               "stands down): %s", e)
@@ -2353,6 +2414,7 @@ class ContinuousBatcher:
                        if self.faults.active() else {}),
                     "pipeline": self.pipeline_stats.report(),
                     "decode_window": self.window_stats.report(),
+                    "prefill": self.prefill_stats.report(),
                     **({"spec": {"k": self.spec_k,
                                  "draft_mode": self.draft_mode,
                                  "draft_exit": self.draft_exit,

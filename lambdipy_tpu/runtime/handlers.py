@@ -569,6 +569,17 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                                             "0"))
             lpf = extra.get("long_prefill",
                             _os.environ.get("LAMBDIPY_LONG_PREFILL", "0"))
+            # whole-prompt sequence-parallel prefill (models/llama.py
+            # sp_prefill family, DEFAULT "chunked"): "sp" runs every
+            # cold prefill as ONE sharded program per round over the
+            # mesh's sp axis — long-context rounds, the engine's group
+            # prefill, and the prefix store's cold walk all route
+            # through it. Requesting it without an sp mesh axis stands
+            # down counted. Extra wins over env (`lambdipy serve
+            # --prefill-mode` bridge).
+            pfm = extra.get("prefill_mode",
+                            _os.environ.get("LAMBDIPY_PREFILL_MODE",
+                                            "chunked"))
             from lambdipy_tpu.runtime.faults import FaultPlan
 
             # paged KV memory (runtime/pagepool.py, DEFAULT OFF): one
@@ -628,7 +639,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 draft_exit=int(dexit or 1),
                 max_logical_ctx=int(mlc or 0),
                 long_prefill=str(lpf).lower() not in ("", "0", "false",
-                                                      "off"))
+                                                      "off"),
+                prefill_mode=str(pfm or "chunked").lower())
         elif window_ms > 0:
             from lambdipy_tpu.runtime.batching import MicroBatcher
 
@@ -695,7 +707,13 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 session_ttl_s=(float(raw_ttl)
                                if raw_ttl not in (None, "") else 3600.0),
                 session_idle_s=(float(raw_idle)
-                                if raw_idle not in (None, "") else 600.0))
+                                if raw_idle not in (None, "") else 600.0),
+                # the store's cold walk shares the engine's prefill
+                # schedule + the ONE batching.prefill stats block
+                prefill_mode=(continuous.prefill_mode
+                              if continuous is not None else "chunked"),
+                prefill_stats=(continuous.prefill_stats
+                               if continuous is not None else None))
             if paged_pool is not None:
                 continuous.prefix_pages_fn = prefix_store.acquire_pages
                 # host KV offload tier (runtime/offload.py, DEFAULT
@@ -988,7 +1006,7 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                     "error": "no continuous engine on this handler "
                              "(pipeline_depth/spec_k are engine knobs)"}
         known = {"pipeline_depth", "spec_k", "draft_mode",
-                 "max_logical_ctx"}
+                 "max_logical_ctx", "prefill_mode"}
         unknown = sorted(set(req) - known)
         if unknown or not (set(req) & known):
             return {"ok": False,
@@ -1042,6 +1060,19 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
             # keep their adapted per-row provider (the fallback chain
             # still demotes them individually)
             continuous.draft_mode = dm
+        if "prefill_mode" in req:
+            pm = str(req["prefill_mode"] or "").lower()
+            if pm not in ("chunked", "sp"):
+                return {"ok": False,
+                        "error": "prefill_mode wants chunked|sp"}
+            # unlike spec_k this is always retunable: "sp" without a
+            # usable mesh stands down counted inside set_prefill_mode,
+            # so a controller can never push prefill off a cliff
+            continuous.set_prefill_mode(pm)
+            if prefix_store is not None:
+                prefix_store.prefill_mode = continuous.prefill_mode
+            if continuous._longctx is not None:
+                continuous._longctx.prefill_mode = continuous.prefill_mode
         if "max_logical_ctx" in req:
             try:
                 m = int(req["max_logical_ctx"])
@@ -1063,7 +1094,8 @@ def generate_handler(spec: dict, ctx) -> HandlerState:
                 "pipeline_depth": continuous.pipeline_depth,
                 "spec_k": continuous.spec_k,
                 "draft_mode": continuous.draft_mode,
-                "max_logical_ctx": continuous.max_logical_ctx}
+                "max_logical_ctx": continuous.max_logical_ctx,
+                "prefill_mode": continuous.prefill_mode}
 
     # background bucket pre-warm: the boot warmup compiles only the
     # smallest prompt bucket; a first request in a bigger bucket pays a

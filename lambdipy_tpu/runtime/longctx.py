@@ -92,7 +92,9 @@ class LongContextRunner:
                  resident_cap: int | None = None,
                  long_prefill: bool = False, faults: Any = None,
                  max_replays: int = 2,
-                 stats: KvOffloadStats | None = None):
+                 stats: KvOffloadStats | None = None,
+                 prefill_mode: str = "chunked",
+                 prefill_stats: Any = None):
         import itertools
 
         cfg = server.model.cfg
@@ -107,6 +109,9 @@ class LongContextRunner:
         self.segment = max(1, int(segment))
         self.max_logical_ctx = int(max_logical_ctx) \
             if max_logical_ctx else 32 * self.window
+        # the boot-time cap: a fleet controller stepping max_logical_ctx
+        # down on offload stalls restores toward this, never past it
+        self.boot_logical_ctx = self.max_logical_ctx
         self.resident_cap = resident_cap
         self.max_replays = max(0, int(max_replays))
         self.stats = stats if stats is not None else KvOffloadStats()
@@ -129,6 +134,12 @@ class LongContextRunner:
             # when only the long-context tier spills
             pool.attach_offload(self.offload)
         self.temp = PageTemperature()
+        # whole-prompt sp prefill (prefill_mode="sp"): the serial
+        # window/2 slide chain collapses to rounds of sp chunks, each
+        # round ONE sharded program (_lsp_round_fn); sp resolves per run
+        # against the live mesh so a bundle swap can't strand the knob
+        self.prefill_mode = prefill_mode
+        self.prefill_stats = prefill_stats
         self.long_prefill = bool(long_prefill)
         self._ring_ok = self._probe_ring() if self.long_prefill else False
         if self.long_prefill and not self._ring_ok:
@@ -148,6 +159,171 @@ class LongContextRunner:
         return (getattr(cfg, "attn_backend", "dense") == "ring"
                 and mesh is not None
                 and dict(getattr(mesh, "shape", {})).get("sp", 1) > 1)
+
+    def _sp_standdown(self, reason: str) -> int:
+        from lambdipy_tpu.parallel.spdecode import note_standdown
+
+        note_standdown(reason)
+        if self.prefill_stats is not None:
+            self.prefill_stats.record_standdown(reason)
+        return 0
+
+    def _sp_factor(self, s: int) -> int:
+        """Shard count for THIS run's prefill, or 0 for the serial
+        chain. Every refusal is a counted stand-down, never silent:
+        prompts of one chunk or less gain nothing from sharding, an odd
+        page count makes the half-window non-page-aligned (the slide
+        schedule the rounds must reproduce moves ``n_view // 2`` whole
+        pages), and a round needs ``(sp + 1) * n_view / 2`` free pages
+        at peak (fresh round pages + the carried prior half-window)."""
+        from lambdipy_tpu.models.llama import resolve_sp_prefill
+
+        sp = resolve_sp_prefill(self.prefill_mode,
+                                getattr(self.server, "mesh", None))
+        if sp < 2:
+            if sp != 0 or self.prefill_mode != "sp":
+                return 0
+            if self.prefill_stats is not None:
+                self.prefill_stats.record_standdown(
+                    "sp_prefill_without_sp_mesh")
+            return 0
+        if s <= self.window // 2:
+            return 0  # one serial chunk already; not a degradation
+        if self.n_view % 2:
+            return self._sp_standdown("sp_prefill_window_not_divisible")
+        need = (sp + 1) * (self.n_view // 2)
+        if self.pool.free_count() < need:
+            return self._sp_standdown("sp_prefill_pool_pressure")
+        return sp
+
+    def _spill_history(self, st: dict, pids: list, lpi0: int) -> None:
+        """Spill already-attended prefill pages (logical pages ``lpi0 +
+        j``) to the offload arena under the run's ``("lc", ...)`` keys
+        and recycle their pool pages — the sp-round twin of the eviction
+        half of :meth:`_slide`. Decode never re-reads them; the spill
+        keeps the run's offload history identical to the serial
+        schedule's (budget refusals land in ``st["lost"]`` the same
+        way)."""
+        from lambdipy_tpu.models.llama import arena_page_slices
+
+        if not pids:
+            return
+        pool, page = self.pool, self.pool.page
+        with pool.arena_lock:
+            arena = pool.ensure_arena()
+        for j, pid in enumerate(pids):
+            lpi = lpi0 + j
+            key = ("lc", st["run_id"], lpi)
+            toks = st["tokens"][lpi * page:(lpi + 1) * page]
+            block = arena_page_slices(arena, pid, page)
+            if self.offload.spill(key, toks, block):
+                st["off"][lpi] = key
+            else:
+                st["lost"].add(lpi)
+        pool.release(pids)
+
+    def _sp_prefill(self, st: dict, row, s: int, knobs, sp: int):
+        """Whole-prompt sequence-parallel prefill: run the serial
+        window/2 slide schedule as ``ceil(s / (sp * window/2))`` ROUNDS
+        of ``sp`` chunks each, every round one sharded program
+        (``server._lsp_round_fn``). The round's union view is [prior
+        half-window][sp fresh chunks]; ``band = window/2`` gives every
+        query exactly the keys its serial chunk would have had resident,
+        so the tokens match the serial chain's. Between rounds the
+        union's head retires through :meth:`_spill_history` and the last
+        half-window carries forward as the next prior. Returns the final
+        round's carry with the cursor already translated into the decode
+        view's frame; ``st`` leaves with the table/base/local the serial
+        chain would have produced."""
+        import jax.numpy as jnp
+
+        from lambdipy_tpu.runtime.pagepool import NULL_PAGE
+
+        server, pool = self.server, self.pool
+        page, window, n_view = self.pool.page, self.window, self.n_view
+        w2 = window // 2
+        rbs = sp * w2
+        rpages = rbs // page
+        ppages = w2 // page
+        t_op, k_op, p_op, keys0, eos_op = knobs
+        rnd = server._lsp_round_fn(sp, pool.n_pages, page, window, sp)
+        n_rounds = -(-s // rbs)
+        layers = int(getattr(server.model.cfg, "layers", 0))
+        t0 = time.monotonic()
+        prior: list = []
+        prior_len = 0
+        carry = None
+        fresh: list = []
+        live: set = set()  # alloc'd pages not yet retired or handed off
+        for r in range(n_rounds):
+            c0 = r * rbs
+            rlen = min(rbs, s - c0)
+            try:
+                fresh = pool.alloc(rpages, tokens=rlen,
+                                   record_shed=False)
+            except BaseException:
+                pool.release(sorted(live))
+                raise
+            live |= set(fresh)
+            prior_len = w2 if r else 0
+            # round 0 has no prior: the head slots point at the null
+            # page, whose gathered bits sit beyond the cache index and
+            # scatter back bitwise-unchanged
+            tbl_list = (prior + fresh) if r else \
+                (fresh + [NULL_PAGE] * ppages)
+            suffix_op, _ = server._pad_rows([row[c0:c0 + rlen]], [rlen],
+                                            1, rbs)
+            tbl = jnp.asarray(tbl_list, jnp.int32)[None, :]
+            with pool.arena_lock:
+                pool.ensure_arena()
+                with server._mesh_ctx():
+                    first, lp0, new_arena, start_c, done_c, keys = rnd(
+                        server.params, pool.arena, tbl,
+                        jnp.int32(prior_len), jnp.int32(c0), suffix_op,
+                        jnp.int32(rlen), t_op, k_op, p_op, keys0,
+                        eos_op)
+                pool.arena = new_arena
+            if self.prefill_stats is not None:
+                self.prefill_stats.record_round(-(-rlen // w2), sp,
+                                                ring_hops=layers * sp)
+            # like the serial chain: only the FINAL round's selection is
+            # the request's first token (same rng operand every round)
+            carry = (first, lp0, start_c, done_c, keys)
+            if r < n_rounds - 1:
+                gs = c0 - prior_len
+                evict = prior + fresh[:-ppages]
+                self._spill_history(st, evict, gs // page)
+                live -= set(evict)
+                prior = fresh[-ppages:]
+        # -- hand off to the decode view: the exact (base, local, table)
+        # the serial slide schedule ends on --------------------------------
+        gs = (n_rounds - 1) * rbs - prior_len
+        union = (prior + fresh) if prior_len else \
+            (fresh + [NULL_PAGE] * ppages)
+        base = max(0, -(-(s - window) // w2)) * w2
+        local = s - base
+        off0 = (base - gs) // page
+        self._spill_history(st, union[:off0], gs // page)
+        st["table"] = union[off0:off0 + n_view]
+        assert len(st["table"]) == n_view \
+            and NULL_PAGE not in st["table"]  # covered: base >= gs and
+        # base + window <= gs + union tokens, both multiples of the page
+        # a RAGGED last round can leave union pages past the decode view
+        # (tokens >= base + window >= s: pure padding) — plain release,
+        # nothing in them is history worth spilling
+        tail = [p for p in union[off0 + n_view:] if p != NULL_PAGE]
+        if tail:
+            self.pool.release(tail)
+        st["base"], st["local"] = base, local
+        self.temp.touch([("lc", st["run_id"], base // page + j)
+                         for j in range(local // page)])
+        if self.prefill_stats is not None:
+            self.prefill_stats.record_walk(time.monotonic() - t0,
+                                           -(-s // w2), n_rounds)
+        first, lp0, start_c, done_c, keys = carry
+        # union-frame cursor (prior_len + rlen) -> decode-view frame
+        start_c = start_c - jnp.int32(base - gs)
+        return first, lp0, start_c, done_c, keys
 
     # -- public --------------------------------------------------------------
 
@@ -345,10 +521,11 @@ class LongContextRunner:
                 f"{self.max_logical_ctx}")
         yield_cap = resident_cap if resident_cap \
             and resident_cap < n_view else None
+        sp = self._sp_factor(s)
         st = {"run_id": next(self._run_ids), "base": 0, "local": 0,
               "tokens": list(row), "off": {}, "lost": set(),
-              "table": list(pool.alloc(n_view, tokens=0,
-                                       record_shed=False)),
+              "table": [] if sp else list(pool.alloc(n_view, tokens=0,
+                                                     record_shed=False)),
               "prefetch": Prefetcher(self.stats)}
         knobs = server._knob_operands(temperature, top_k, top_p, seed,
                                       eos_id, b=1)
@@ -356,39 +533,52 @@ class LongContextRunner:
         out_toks: list = []
         out_lps: list = []
         try:
-            # -- chunked prefill through the sliding view ---------------------
-            chunk = window // 2
-            carry = None
-            for c0 in range(0, s, chunk):
-                clen = min(chunk, s - c0)
-                while st["local"] + clen > window:
-                    self._slide(st, n_view // 2)
-                sbs = min(_next_bucket(clen, server.min_bucket),
-                          window - st["local"])
-                cont = server._lpaged_continue_fn(sbs, pool.n_pages, page,
-                                                  window)
-                suffix_op, _ = server._pad_rows([row[c0:c0 + clen]],
-                                                [clen], 1, sbs)
-                tbl = self._view_table(st)
-                with pool.arena_lock:
-                    pool.ensure_arena()
-                    with server._mesh_ctx():
-                        first, lp0, new_arena, start_c, done_c, keys = \
-                            cont(server.params, pool.arena, tbl,
-                                 jnp.int32(st["local"]),
-                                 jnp.int32(st["base"]), suffix_op,
-                                 jnp.int32(clen), t_op, k_op, p_op,
-                                 keys0, eos_op)
-                    pool.arena = new_arena
-                st["local"] += clen
-                self.temp.touch([("lc", st["run_id"], st["base"] // page + j)
-                                 for j in range(st["local"] // page)])
-                # only the FINAL chunk's selection is the request's
-                # first token; mid-chunk selections are discarded (the
-                # rng operand is the same each chunk, so the final
-                # split matches a single whole-prompt prefill's)
-                carry = (first, lp0, start_c, done_c, keys)
-            first, lp0, start_c, done_c, keys = carry
+            if sp:
+                # -- whole-prompt sp prefill: sharded rounds ------------------
+                first, lp0, start_c, done_c, keys = \
+                    self._sp_prefill(st, row, s, knobs, sp)
+            else:
+                # -- chunked prefill through the sliding view -----------------
+                t_pf = time.monotonic()
+                chunk = window // 2
+                carry = None
+                for c0 in range(0, s, chunk):
+                    clen = min(chunk, s - c0)
+                    while st["local"] + clen > window:
+                        self._slide(st, n_view // 2)
+                    sbs = min(_next_bucket(clen, server.min_bucket),
+                              window - st["local"])
+                    cont = server._lpaged_continue_fn(sbs, pool.n_pages,
+                                                      page, window)
+                    suffix_op, _ = server._pad_rows([row[c0:c0 + clen]],
+                                                    [clen], 1, sbs)
+                    tbl = self._view_table(st)
+                    with pool.arena_lock:
+                        pool.ensure_arena()
+                        with server._mesh_ctx():
+                            first, lp0, new_arena, start_c, done_c, keys = \
+                                cont(server.params, pool.arena, tbl,
+                                     jnp.int32(st["local"]),
+                                     jnp.int32(st["base"]), suffix_op,
+                                     jnp.int32(clen), t_op, k_op, p_op,
+                                     keys0, eos_op)
+                        pool.arena = new_arena
+                    st["local"] += clen
+                    self.temp.touch(
+                        [("lc", st["run_id"], st["base"] // page + j)
+                         for j in range(st["local"] // page)])
+                    if self.prefill_stats is not None:
+                        self.prefill_stats.record_round(1, 1)
+                    # only the FINAL chunk's selection is the request's
+                    # first token; mid-chunk selections are discarded (the
+                    # rng operand is the same each chunk, so the final
+                    # split matches a single whole-prompt prefill's)
+                    carry = (first, lp0, start_c, done_c, keys)
+                first, lp0, start_c, done_c, keys = carry
+                if self.prefill_stats is not None:
+                    n_chunks = -(-s // chunk)
+                    self.prefill_stats.record_walk(
+                        time.monotonic() - t_pf, n_chunks, n_chunks)
             # -- segment decode over the sliding view -------------------------
             seg_len = self.segment
             seg_fn = server._lpaged_seg_fn(1, pool.n_pages, page, window,
@@ -463,7 +653,9 @@ class LongContextRunner:
     def report(self) -> dict:
         return {"window": self.window, "segment": self.segment,
                 "max_logical_ctx": self.max_logical_ctx,
+                "boot_logical_ctx": self.boot_logical_ctx,
                 "resident_cap": self.resident_cap,
                 "long_prefill": self.long_prefill,
                 "ring_active": self._ring_ok,
+                "prefill_mode": self.prefill_mode,
                 **self.offload.gauges(), **self.stats.report()}

@@ -658,6 +658,81 @@ class KvOffloadStats:
 
 
 @dataclass
+class PrefillStats:
+    """Counters for the cold-prefill tier (the ``batching.prefill``
+    block on ``/metrics``), shared by the continuous engine's prefill
+    paths and the prefix store's cold walks. A ROUND is one program
+    dispatch on the TTFT critical path; under ``prefill_mode=sp`` a
+    round carries up to ``sp`` chunk-widths of the prompt (shard
+    occupancy = chunks / (rounds x sp)), under ``chunked`` every round
+    is one chunk. ``ring_collectives`` counts the modeled ring hops of
+    sharded first-round programs (layers x sp ppermute steps each).
+    ``critical_path_s`` is host wall time over whole walks — with
+    device time modeled through the ``prefix_walk`` delay site (the
+    --disagg / --sp-prefill bench idiom) it IS the modeled TTFT
+    critical path; ``serial_equiv_s`` scales each walk's wall by its
+    chunks/rounds ratio, the chunked-equivalent cost the sharded
+    schedule avoided. ``standdowns`` mirrors the counted reasons a
+    requested sp prefill ran chunked (no sp mesh axis, pool pressure,
+    window not divisible)."""
+
+    mode: str = "chunked"
+    sp: int = 0
+    rounds: int = 0
+    chunks: int = 0
+    sharded_chunks: int = 0
+    ring_collectives: int = 0
+    walks: int = 0
+    critical_path_s: float = 0.0
+    serial_equiv_s: float = 0.0
+    standdowns: dict = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def configure(self, mode: str, sp: int) -> None:
+        with self._lock:
+            self.mode = str(mode)
+            self.sp = int(sp)
+
+    def record_round(self, chunks: int, sp: int, *,
+                     ring_hops: int = 0) -> None:
+        with self._lock:
+            self.rounds += 1
+            self.chunks += int(chunks)
+            if sp >= 2:
+                self.sharded_chunks += int(chunks)
+            self.ring_collectives += int(ring_hops)
+
+    def record_walk(self, wall_s: float, chunks: int, rounds: int) -> None:
+        with self._lock:
+            self.walks += 1
+            self.critical_path_s += float(wall_s)
+            self.serial_equiv_s += float(wall_s) * (
+                int(chunks) / max(1, int(rounds)))
+
+    def record_standdown(self, reason: str) -> None:
+        with self._lock:
+            self.standdowns[reason] = self.standdowns.get(reason, 0) + 1
+
+    def report(self) -> dict:
+        with self._lock:
+            slots = self.rounds * max(1, self.sp)
+            return {
+                "mode": self.mode,
+                "sp": self.sp,
+                "rounds": self.rounds,
+                "chunks": self.chunks,
+                "sharded_chunks": self.sharded_chunks,
+                "shard_occupancy": (
+                    round(self.chunks / slots, 4) if self.rounds else 0.0),
+                "ring_collectives": self.ring_collectives,
+                "walks": self.walks,
+                "critical_path_s": round(self.critical_path_s, 6),
+                "serial_equiv_s": round(self.serial_equiv_s, 6),
+                "standdowns": dict(self.standdowns),
+            }
+
+
+@dataclass
 class KvShipStats:
     """Replica-side counters for the disaggregated-serving KV ship
     surface (the ``batching.disagg`` block on ``/metrics``). Exports are

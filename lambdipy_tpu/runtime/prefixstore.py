@@ -171,10 +171,21 @@ class PrefixStore:
                  budget_mb: float = 512.0, pool: Any = None,
                  faults: Any = None, pin_budget_mb: float | None = None,
                  session_ttl_s: float = 3600.0,
-                 session_idle_s: float = 600.0):
+                 session_idle_s: float = 600.0,
+                 prefill_mode: str = "chunked", prefill_stats: Any = None):
         from lambdipy_tpu.runtime.pagepool import page_width
 
         self.server = server
+        # "chunked" (the serial walk) or "sp": cold walks dispatch rounds
+        # of sp x walk_chunk tokens as ONE sharded program each — the
+        # whole-prompt sequence-parallel prefill tier. Resolved against
+        # the server's mesh per walk (_sp_factor): no sp axis stands the
+        # walk down to chunked with a counted reason, never silently.
+        self.prefill_mode = prefill_mode
+        # shared PrefillStats (runtime/metrics.py) — the handler passes
+        # the engine's instance so /metrics shows ONE batching.prefill
+        # block across engine prefill and store walks
+        self.prefill_stats = prefill_stats
         # FaultPlan | None; site "prefix_walk" fires once per cold-walk
         # chunk dispatch: an injected exception fails the walk OPEN
         # (route() serves the request unrouted), a delay models the
@@ -1125,14 +1136,37 @@ class PrefixStore:
                       for name, val in entry.items()}
                      for entry in blk] for blk in jb]
 
+        sp = self._sp_factor()
+        rk = self.walk_chunk * sp
+        t_walk = time.monotonic()
+        n_rounds = n_chunks = 0
         with server._mesh_ctx():
-            if matched == 0:
+            if matched == 0 and sp >= 2 and target >= rk \
+                    and rk <= cfg.max_len:
+                # sharded export: the export IS the prefill, and one
+                # round ships sp walk-chunks of KV per occupancy slot
+                pf = server._sp_first_fn(rk, cfg.max_len, sp)
+                prompt_op, _ = server._pad_rows([row[:rk]], [rk], 1, rk)
+                self._walk_fault()
+                cache = pf(server.params, prompt_op, jnp.int32(rk))
+                pos = rk
+                n_rounds += 1
+                n_chunks += sp
+                if self.prefill_stats is not None:
+                    self.prefill_stats.record_round(
+                        sp, sp, ring_hops=cfg.layers * sp)
+                yield emit(cache, 0, rk)
+            elif matched == 0:
                 fw = self.walk_chunk if target >= self.walk_chunk else bk
                 pf = server._prefix_first_fn(fw, cfg.max_len)
                 prompt_op, _ = server._pad_rows([row[:fw]], [fw], 1, fw)
                 self._walk_fault()
                 cache = pf(server.params, prompt_op, jnp.int32(fw))
                 pos = fw
+                n_rounds += 1
+                n_chunks += 1
+                if self.prefill_stats is not None:
+                    self.prefill_stats.record_round(1, 1)
                 yield emit(cache, 0, fw)
             elif self.pool is not None:
                 gather = server._paged_gather_fn(
@@ -1159,14 +1193,32 @@ class PrefixStore:
             wk = self.walk_chunk
             ext = server._prefix_ext_fn(bk)
             ext_wide = server._prefix_ext_fn(wk) if wk > bk else None
+            ext_round = (server._sp_ext_fn(rk, sp)
+                         if sp >= 2 and rk <= cfg.max_len else None)
             while pos < target:
                 self._walk_fault()
-                if (ext_wide is not None and target - pos >= wk
+                if (ext_round is not None and target - pos >= rk
+                        and pos + rk <= cfg.max_len):
+                    chunk_op, _ = server._pad_rows(
+                        [row[pos:pos + rk]], [rk], 1, rk)
+                    cache = ext_round(server.params, cache, chunk_op,
+                                      jnp.int32(rk))
+                    n_rounds += 1
+                    n_chunks += sp
+                    if self.prefill_stats is not None:
+                        self.prefill_stats.record_round(sp, sp)
+                    yield emit(cache, pos, pos + rk)
+                    pos += rk
+                elif (ext_wide is not None and target - pos >= wk
                         and pos + wk <= cfg.max_len):
                     chunk_op, _ = server._pad_rows(
                         [row[pos:pos + wk]], [wk], 1, wk)
                     cache = ext_wide(server.params, cache, chunk_op,
                                      jnp.int32(wk))
+                    n_rounds += 1
+                    n_chunks += 1
+                    if self.prefill_stats is not None:
+                        self.prefill_stats.record_round(1, 1)
                     yield emit(cache, pos, pos + wk)
                     pos += wk
                 else:
@@ -1174,8 +1226,15 @@ class PrefixStore:
                         [row[pos:pos + bk]], [bk], 1, bk)
                     cache = ext(server.params, cache, chunk_op,
                                 jnp.int32(bk))
+                    n_rounds += 1
+                    n_chunks += 1
+                    if self.prefill_stats is not None:
+                        self.prefill_stats.record_round(1, 1)
                     yield emit(cache, pos, pos + bk)
                     pos += bk
+            if self.prefill_stats is not None:
+                self.prefill_stats.record_walk(
+                    time.monotonic() - t_walk, n_chunks, n_rounds)
             if self.pool is None:
                 # register the full cache like _walk does, so the next
                 # local hit on this prefix skips reassembly
@@ -1264,9 +1323,21 @@ class PrefixStore:
                     "thread did not complete within 300s")
 
     def _walk_fault(self) -> None:
-        """``prefix_walk`` site: once per cold-walk chunk dispatch."""
+        """``prefix_walk`` site: once per cold-walk chunk dispatch — and
+        in sp-prefill mode once per ROUND, which is exactly the tier's
+        bench story: both modes price identical modeled per-chunk device
+        time through this site, the sharded walk just stacks sp chunks
+        onto one critical-path slot."""
         if self.faults is not None:
             self.faults.check("prefix_walk")
+
+    def _sp_factor(self) -> int:
+        """Usable whole-prompt sp-prefill factor for cold walks (0 =
+        chunked; stand-down counted in resolve_sp_prefill)."""
+        from lambdipy_tpu.models.llama import resolve_sp_prefill
+
+        return resolve_sp_prefill(self.prefill_mode,
+                                  getattr(self.server, "mesh", None))
 
     def _walk(self, row: list, matched: int, target: int,
               path: list) -> None:
@@ -1281,8 +1352,27 @@ class PrefixStore:
         server = self.server
         cfg = server.model.cfg
         bk = self.block
+        sp = self._sp_factor()
+        rk = self.walk_chunk * sp  # sp-round width (0 when chunked)
+        t_walk = time.monotonic()
+        n_rounds = n_chunks = 0
         with server._mesh_ctx():
-            if matched == 0:
+            if matched == 0 and sp >= 2 and target >= rk \
+                    and rk <= cfg.max_len:
+                # whole-prompt sp first round: ONE sharded program covers
+                # sp walk-chunks — for prompts that fit a round, the
+                # entire cold prefill is this single dispatch
+                pf = server._sp_first_fn(rk, cfg.max_len, sp)
+                prompt_op, _ = server._pad_rows([row[:rk]], [rk], 1, rk)
+                self._walk_fault()
+                cache = pf(server.params, prompt_op, jnp.int32(rk))
+                pos = rk
+                n_rounds += 1
+                n_chunks += sp
+                if self.prefill_stats is not None:
+                    self.prefill_stats.record_round(
+                        sp, sp, ring_hops=cfg.layers * sp)
+            elif matched == 0:
                 # first chunk rides the wide family too when it fits
                 fw = self.walk_chunk if target >= self.walk_chunk else bk
                 pf = server._prefix_first_fn(fw, cfg.max_len)
@@ -1290,6 +1380,10 @@ class PrefixStore:
                 self._walk_fault()
                 cache = pf(server.params, prompt_op, jnp.int32(fw))
                 pos = fw
+                n_rounds += 1
+                n_chunks += 1
+                if self.prefill_stats is not None:
+                    self.prefill_stats.record_round(1, 1)
             elif self.pool is not None:
                 # paged: the matched blocks live in arena pages — gather
                 # them into the walk's contiguous working cache (a
@@ -1327,23 +1421,49 @@ class PrefixStore:
             wk = self.walk_chunk
             ext = server._prefix_ext_fn(bk)
             ext_wide = server._prefix_ext_fn(wk) if wk > bk else None
+            ext_round = (server._sp_ext_fn(rk, sp)
+                         if sp >= 2 and rk <= cfg.max_len else None)
             while pos < target:
                 self._walk_fault()
-                if (ext_wide is not None and target - pos >= wk
+                if (ext_round is not None and target - pos >= rk
+                        and pos + rk <= cfg.max_len):
+                    # one sharded ROUND = sp serial chunks, one
+                    # critical-path slot (one fault fire above)
+                    chunk_op, _ = server._pad_rows(
+                        [row[pos:pos + rk]], [rk], 1, rk)
+                    cache = ext_round(server.params, cache, chunk_op,
+                                      jnp.int32(rk))
+                    pos += rk
+                    n_rounds += 1
+                    n_chunks += sp
+                    if self.prefill_stats is not None:
+                        self.prefill_stats.record_round(sp, sp)
+                elif (ext_wide is not None and target - pos >= wk
                         and pos + wk <= cfg.max_len):
                     chunk_op, _ = server._pad_rows(
                         [row[pos:pos + wk]], [wk], 1, wk)
                     cache = ext_wide(server.params, cache, chunk_op,
                                      jnp.int32(wk))
                     pos += wk
+                    n_rounds += 1
+                    n_chunks += 1
+                    if self.prefill_stats is not None:
+                        self.prefill_stats.record_round(1, 1)
                 else:
                     chunk_op, _ = server._pad_rows(
                         [row[pos:pos + bk]], [bk], 1, bk)
                     cache = ext(server.params, cache, chunk_op,
                                 jnp.int32(bk))
                     pos += bk
+                    n_rounds += 1
+                    n_chunks += 1
+                    if self.prefill_stats is not None:
+                        self.prefill_stats.record_round(1, 1)
             new_blocks = [slice_cache_blocks(cache, p, bk)
                           for p in range(matched, target, bk)]
+        if self.prefill_stats is not None:
+            self.prefill_stats.record_walk(
+                time.monotonic() - t_walk, n_chunks, n_rounds)
         if self.pool is not None:
             # paged insertion: each fresh block gets its own arena page
             # (store-owned ref); the full-window walk cache is a

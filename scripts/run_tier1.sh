@@ -378,4 +378,23 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 phase_end "phase 17"
+
+# Phase 18: whole-prompt sequence-parallel prefill — bench.py
+# --sp-prefill (2 forced CPU host devices for the sp=2 mesh) exits
+# nonzero if any prefill_mode=sp output diverges bitwise from the
+# chunked engine on the same sharded server (greedy + seeded-sampled,
+# cold + prefix-store hit, streamed, concurrent, dense + paged), if
+# the long-context runner's sharded round schedule diverges from the
+# serial window/2 slide chain at 8x/16x the compiled window (or leaks
+# pool pages), or if cold TTFT through the sp walk exceeds 0.6x the
+# chunked walk with per-chunk prefill device time modeled through the
+# deterministic prefix_walk delay site (the PR-12b idiom: the sharded
+# walk stacks sp chunks of device time onto one critical-path slot).
+phase_begin "phase 18: sp prefill gate (bench.py --sp-prefill)"
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python bench.py --sp-prefill; then
+    echo "FATAL: bench.py --sp-prefill gate failed" >&2
+    exit 1
+fi
+phase_end "phase 18"
 exit 0

@@ -447,3 +447,118 @@ def test_generate_timeline_rejects_unfittable_configs():
         generate_timeline(seed=1, duration_s=5.0, replicas=REPLICAS)
     with pytest.raises(ValueError, match=">= 2 replicas"):
         generate_timeline(seed=1, duration_s=22.0, replicas=["r0"])
+
+
+# -- the offload_stall nemesis legs -------------------------------------------
+
+
+def test_fault_menu_offers_offload_stall_legs():
+    """The nemesis menu (derived from the site registry) must offer
+    offload_stall on replicas in BOTH store-owned kinds — delay (a slow
+    re-online, timed as a stall) and exception (a failed re-online,
+    degraded to a counted recompute) — and never hang (a store-owned
+    hang has no replay machinery to resolve it)."""
+    from lambdipy_tpu.chaos.nemesis import _fault_menu
+
+    menu = _fault_menu(REPLICAS + [ROUTER])
+    assert ("r0", "offload_stall", "delay") in menu
+    assert ("r1", "offload_stall", "exception") in menu
+    assert not any(site == "offload_stall" and kind == "hang"
+                   for _, site, kind in menu)
+    assert not any(t == ROUTER and site == "offload_stall"
+                   for t, site, _ in menu)
+
+
+@pytest.mark.parametrize("seed", [0, 7, 11, 23, 99, 1234])
+def test_timeline_must_include_guarantees_offload_stall(seed):
+    """must_include="offload_stall" puts at least one armed
+    offload_stall leg in EVERY seed's schedule (the soak composes the
+    offload tier's failure mode deliberately, not when the dice feel
+    like it), without breaking the structural floor or the byte-
+    identical-replay contract."""
+    events = generate_timeline(seed=seed, duration_s=22.0,
+                               replicas=REPLICAS,
+                               must_include="offload_stall")
+    arms = [e for e in events if e.action == "arm"
+            and e.spec.partition(":")[0] == "offload_stall"]
+    assert arms, "no offload_stall leg in the guaranteed schedule"
+    props = timeline_properties(events)
+    assert props["kills"] >= 1 and props["drains"] >= 1
+    assert props["peak_overlap"] <= 3
+    # same seed + same knob -> byte-identical schedule
+    again = generate_timeline(seed=seed, duration_s=22.0,
+                              replicas=REPLICAS,
+                              must_include="offload_stall")
+    assert render_timeline(events) == render_timeline(again)
+    with pytest.raises(ValueError, match="no menu legs"):
+        generate_timeline(seed=seed, duration_s=22.0,
+                          replicas=REPLICAS,
+                          must_include="no_such_site")
+
+
+def test_soak_window_composed_offload_stall_zero_silent_loss(tiny_server):
+    """A soak-style window with offload_stall composed in, in-process:
+    requests riding SPILLED prefixes under an armed offload_stall
+    still deliver bitwise tokens. The delay leg is a timed re-online
+    stall; the exception leg degrades to a counted recompute through
+    the dense fallback (deterministic — the prefill replays the same
+    math the pages held). The history checker is the oracle: zero
+    silent losses, every outcome delivered."""
+    import time as _time
+
+    import numpy as np
+
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+    from lambdipy_tpu.runtime.faults import FaultPlan
+    from lambdipy_tpu.runtime.offload import OffloadArena
+    from lambdipy_tpu.runtime.prefixstore import PrefixStore
+    from tests.test_long_context import mk_pool
+
+    plan = FaultPlan.empty()
+    pool = mk_pool(tiny_server, extra_pages=4)
+    store = PrefixStore(tiny_server, pool=pool)
+    off = OffloadArena(page=pool.page,
+                       layers=tiny_server.model.cfg.layers,
+                       faults=plan)
+    store.attach_offload(off)
+    eng = ContinuousBatcher(tiny_server, slots=2, segment=4,
+                            page_pool=pool)
+    eng.prefix_pages_fn = store.acquire_pages
+
+    row = np.random.default_rng(31).integers(
+        5, 100, size=65).tolist()
+    ref = np.asarray(tiny_server.generate(row, max_new_tokens=8))
+
+    def request(rid, kind):
+        t0 = _time.monotonic()
+        m = store.route(row)
+        assert m == 64
+        out = eng.generate(row[m:], max_new_tokens=8,
+                           prefix=np.asarray(row[:m], np.int32))
+        return Outcome(rid=rid, kind=kind, streamed=False,
+                       sampled=False, t_start=t0,
+                       t_end=_time.monotonic(), status="ok",
+                       tokens=np.asarray(out).ravel().tolist(),
+                       expected=np.asarray(ref).ravel().tolist())
+
+    outcomes = [request(1, "cold")]
+    # spill the whole prefix to the host tier, then hit it under the
+    # DELAY leg: the batched re-online pays the injected stall
+    while store.reclaim_pages(1):
+        pass
+    assert store.check_invariants()["offloaded_blocks"] == 4
+    plan.arm("offload_stall:delay@ms=60,n=1")
+    outcomes.append(request(2, "hit"))
+    assert off.report()["reonlines"] >= 1
+    # spill again and hit under the EXCEPTION leg: the failed
+    # re-online degrades to the dense-fallback recompute, counted
+    while store.reclaim_pages(1):
+        pass
+    plan.clear()
+    plan.arm("offload_stall:exception@n=1")
+    outcomes.append(request(3, "hit"))
+    assert off.report()["recomputes"] >= 1
+    v = check_history(outcomes, waiter_bound_s=60.0)
+    assert v["ok"], v["violations"]
+    assert v["tallies"]["delivered"] == 3
+    assert v["tallies"]["silent"] == 0

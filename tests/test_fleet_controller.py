@@ -499,3 +499,127 @@ def test_scheduler_stamps_wait_ms_at_grant():
     assert s.wait_turn(t2, timeout=5)
     assert t2.wait_ms is not None and t2.wait_ms >= 0.0
     s.finish(t2)
+
+
+# -- the max_logical_ctx retune (offload-stall damped rule) -------------------
+
+
+def _lc_views(**kw):
+    base = dict(name="r0", role=MIXED, max_logical_ctx=2048,
+                compiled_window=128, boot_logical_ctx=2048,
+                offload_stall_frac=0.0, prefetch_hit_rate=0.9)
+    base.update(kw)
+    return (ReplicaView(**base),)
+
+
+def test_logical_ctx_halves_on_sustained_stalls():
+    acts = decide(_knob_snap(1.0, _lc_views(offload_stall_frac=0.2)),
+                  PolicyState(), _cfg())
+    assert [(a.kind, a.knob, a.value) for a in acts] == \
+        [(SET_KNOB, "max_logical_ctx", 1024)]
+    assert "stall" in acts[0].reason
+
+
+def test_logical_ctx_never_steps_below_the_compiled_window():
+    # halving 200 would land at 100 — the floor is the window (128)
+    acts = decide(_knob_snap(1.0, _lc_views(max_logical_ctx=200,
+                                            offload_stall_frac=0.5)),
+                  PolicyState(), _cfg())
+    assert [(a.knob, a.value) for a in acts] == [("max_logical_ctx", 128)]
+    # already at the window: stalls or not, nothing to shrink
+    assert decide(_knob_snap(1.0, _lc_views(max_logical_ctx=128,
+                                            offload_stall_frac=0.5)),
+                  PolicyState(), _cfg()) == []
+
+
+def test_logical_ctx_low_prefetch_corroborates_mid_band_stalls():
+    # stalls inside the band alone: hold
+    assert decide(_knob_snap(1.0, _lc_views(offload_stall_frac=0.05)),
+                  PolicyState(), _cfg()) == []
+    # same stalls + a collapsed prefetch hit rate: step down
+    acts = decide(_knob_snap(1.0, _lc_views(offload_stall_frac=0.05,
+                                            prefetch_hit_rate=0.2)),
+                  PolicyState(), _cfg())
+    assert [(a.knob, a.value) for a in acts] == [("max_logical_ctx",
+                                                  1024)]
+    # clean stalls: a bad hit rate alone never shrinks the window
+    assert decide(_knob_snap(1.0, _lc_views(offload_stall_frac=0.01,
+                                            prefetch_hit_rate=0.2)),
+                  PolicyState(), _cfg()) == []
+
+
+def test_logical_ctx_restores_on_clean_windows_capped_at_boot():
+    # clean window, previously stepped down: double back up
+    acts = decide(_knob_snap(1.0, _lc_views(max_logical_ctx=512,
+                                            boot_logical_ctx=2048)),
+                  PolicyState(), _cfg())
+    assert [(a.knob, a.value) for a in acts] == [("max_logical_ctx",
+                                                  1024)]
+    # doubling past boot clamps to boot
+    acts = decide(_knob_snap(1.0, _lc_views(max_logical_ctx=1536,
+                                            boot_logical_ctx=2048)),
+                  PolicyState(), _cfg())
+    assert [(a.knob, a.value) for a in acts] == [("max_logical_ctx",
+                                                  2048)]
+    # at boot already: a clean window is the steady state, not a signal
+    assert decide(_knob_snap(1.0, _lc_views()), PolicyState(),
+                  _cfg()) == []
+
+
+def test_logical_ctx_skips_unpublished_signals():
+    # no long-context block on the replica: every field is None
+    for missing in ("offload_stall_frac", "max_logical_ctx",
+                    "compiled_window"):
+        kw = {"offload_stall_frac": 0.5, missing: None}
+        assert decide(_knob_snap(1.0, _lc_views(**kw)),
+                      PolicyState(), _cfg()) == []
+
+
+def test_logical_ctx_cooldown_damps_the_rule():
+    cfg = _cfg(knob_cooldown_s=5.0)
+    state = PolicyState()
+    views = _lc_views(offload_stall_frac=0.5)
+    acts = decide(_knob_snap(0.0, views), state, cfg)
+    assert [(a.knob, a.value) for a in acts] == [("max_logical_ctx",
+                                                  1024)]
+    # still stalling one tick later: the cooldown holds the knob
+    assert decide(_knob_snap(1.0, views), state, cfg) == []
+    # cooldown over: the next halving lands
+    acts = decide(_knob_snap(5.0, views), state, cfg)
+    assert [(a.knob, a.value) for a in acts] == [("max_logical_ctx",
+                                                  1024)]
+
+
+def _lc_metrics(stall_s, *, wall_s=10.0, mlc=2048, hit=0.9):
+    return {"replicas": {"a": {"handler": {"batching": {
+        "pipeline": {"wall_s": wall_s},
+        "long_context": {"stall_s": stall_s, "prefetch_hit_rate": hit,
+                         "max_logical_ctx": mlc, "window": 128,
+                         "boot_logical_ctx": 2048}}}}}}
+
+
+def test_controller_retunes_logical_ctx_over_debug_knobs(monkeypatch):
+    posts = []
+
+    def fake_post(url, payload, timeout=None):
+        posts.append((url, payload))
+        return {"ok": True}
+
+    monkeypatch.setattr("lambdipy_tpu.fleet.controller._http_json",
+                        fake_post)
+    pool = FakePool([FakeReplica("a")])
+    pool.replicas["a"].url = "http://a:1"
+    seq = iter([_lc_metrics(3.0),              # 30% stall -> halve
+                _lc_metrics(3.0, mlc=1024),    # still hot -> halve again
+                _lc_metrics(0.1, mlc=512),     # clean -> restore
+                _lc_metrics(0.1, mlc=1024)])   # clean -> restore
+    router = FakeRouter(pool, lambda: next(seq))
+    ctrl = FleetController(router, config=_cfg(), interval_s=99)
+    for _ in range(4):
+        ctrl.tick()
+    assert posts == [("http://a:1/v1/debug/knobs",
+                      {"max_logical_ctx": v})
+                     for v in (1024, 512, 1024, 2048)]
+    # the recorded decisions replay byte-for-byte
+    assert len(ctrl.decision_log) == 4
+    assert ctrl.replay_decisions() is True

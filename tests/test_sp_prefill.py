@@ -1,0 +1,385 @@
+"""Whole-prompt sequence-parallel prefill (models/llama.py sp_prefill
+family + parallel/ring.py sp_chunk_attention).
+
+The bar is the serving standard everywhere both paths exist: the
+sharded program's OUTPUT must match the serial chain's (allclose at the
+attention level, token-for-token through the runners), ragged last
+rounds pad without contaminating reachable cells, paged scatter lands in
+shuffled tables without touching distractor pages, and asking for sp
+without a mesh stands down counted — never silently."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lambdipy_tpu.models import registry
+from lambdipy_tpu.models.llama import (
+    _attend,
+    _continue_prefill,
+    _serve_select,
+    resolve_sp_prefill,
+)
+from lambdipy_tpu.parallel.mesh import make_mesh, use_mesh
+from lambdipy_tpu.parallel.ring import sp_chunk_attention
+from lambdipy_tpu.parallel.sharding import shard_params
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=shape),
+                       jnp.float32)
+
+
+# -- the sharded-vs-dense attention oracle -----------------------------------
+
+
+@pytest.mark.parametrize("kvh", [4, 2])
+def test_sp_chunk_attention_matches_dense(cpu_devices, kvh):
+    """Query-sharded chunk attention over a replicated cache == the
+    dense reference, GQA included, under an arbitrary validity mask."""
+    b, s, t, h, d = 2, 32, 48, 4, 16
+    q = _rand((b, s, h, d), 0)
+    k = _rand((b, t, kvh, d), 1)
+    v = _rand((b, t, kvh, d), 2)
+    # the serve-path mask shape [b, s, t]: causal from a cache index,
+    # i.e. query j attends keys <= idx + j
+    idx = 16
+    mask = (jnp.arange(t)[None, None, :]
+            <= (idx + jnp.arange(s))[None, :, None])
+    mask = jnp.broadcast_to(mask, (b, s, t))
+    ref = _attend(q, k, v, mask)
+    mesh = make_mesh({"sp": 4}, devices=cpu_devices[:4])
+    out = sp_chunk_attention(q, k, v, mask, mesh)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sp_chunk_attention_banded_mask(cpu_devices):
+    """The long-context sliding band (keys >= band_start per query) is
+    just another mask to the sharded kernel — parity must hold when
+    rows attend DIFFERENT key windows across shards."""
+    b, s, t, h, d = 1, 32, 64, 2, 8
+    q = _rand((b, s, h, d), 3)
+    k = _rand((b, t, h, d), 4)
+    v = _rand((b, t, h, d), 5)
+    idx, band = 16, 16
+    qpos = idx + jnp.arange(s)
+    valid = (jnp.arange(t)[None, None, :] <= qpos[None, :, None])
+    band_start = jnp.maximum(0, (qpos // band - 1) * band)
+    valid = valid & (jnp.arange(t)[None, None, :]
+                     >= band_start[None, :, None])
+    mask = jnp.broadcast_to(valid, (b, s, t))
+    ref = _attend(q, k, v, mask)
+    mesh = make_mesh({"sp": 2}, devices=cpu_devices[:2])
+    out = sp_chunk_attention(q, k, v, mask, mesh)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sp_chunk_attention_rejects_uneven_width(cpu_devices):
+    b, s, t, h, d = 1, 30, 32, 2, 8
+    q, k, v = (_rand((b, n, h, d), i) for i, n in [(0, s), (1, t), (2, t)])
+    mask = jnp.ones((b, s, t), jnp.bool_)
+    mesh = make_mesh({"sp": 4}, devices=cpu_devices[:4])
+    with pytest.raises(ValueError, match="not divisible"):
+        sp_chunk_attention(q, k, v, mask, mesh)
+
+
+# -- program-family parity on the serving stack ------------------------------
+
+
+@pytest.fixture(scope="module")
+def sp_server(cpu_devices):
+    """A tiny server on an sp=2 mesh: the sp-prefill programs shard
+    over it, the serial programs ignore it — one server serves as both
+    sides of every parity check below."""
+    adapter = registry.get("llama-tiny").build()
+    params = adapter.init_params(seed=0)
+    mesh = make_mesh({"sp": 2}, devices=cpu_devices[:2])
+    with use_mesh(mesh):
+        sp_params = shard_params(params, mesh, adapter.tp_rules)
+    return adapter.make_server(sp_params, mesh=mesh, prefill_chunk=16)
+
+
+def _cache_kv(cache, upto):
+    """Concatenate the reachable K/V cells of a serve cache."""
+    out = []
+    for entry in cache:
+        for name in ("k", "v"):
+            out.append(np.asarray(entry[name])[:, :upto])
+    return out
+
+
+def test_sp_prefill_cache_matches_chunked_walk(sp_server):
+    """The whole-prompt sp walk must land the same cache the serial
+    chunk chain lands — including a RAGGED last round (upto chosen so
+    the final round pads) and rounds at several shard bases."""
+    server = sp_server
+    cfg = server.model.cfg
+    rng = np.random.default_rng(7)
+    # 3 sp rounds of 2 chunks each, last one ragged
+    ck = server.prefill_chunk
+    upto = 2 * (2 * ck) + ck + 3
+    assert upto < cfg.max_len
+    row = rng.integers(5, cfg.vocab_size - 5, size=upto).tolist()
+    with server._mesh_ctx():
+        serial = server._chunked_prefill_cache(row, upto, cfg.max_len)
+        sharded = server._chunked_prefill_cache(row, upto, cfg.max_len,
+                                                sp=2)
+    assert int(np.asarray(serial[0]["index"])) == upto
+    assert int(np.asarray(sharded[0]["index"])) == upto
+    for a, b in zip(_cache_kv(serial, upto), _cache_kv(sharded, upto)):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-4)
+
+
+def test_sp_continue_prefill_pos_offset_parity(sp_server):
+    """``pos_offset`` (the long-context logical-position split) must
+    reach RoPE identically under the sharded program at EVERY shard
+    base: serial vs sp ``_continue_prefill`` on the same cache,
+    swept over offsets."""
+    server = sp_server
+    cfg = server.model.cfg
+    rng = np.random.default_rng(11)
+    base, sbs = 32, 32
+    row = rng.integers(5, cfg.vocab_size - 5, size=base + sbs).tolist()
+    t_op, k_op, p_op, keys0, eos_op = server._knob_operands(
+        0.0, None, None, 0, None, b=1)
+    select = _serve_select(t_op, k_op, p_op)
+    for off in (0, 16, 48):
+        with server._mesh_ctx():
+            pf = server._prefix_first_fn(base, cfg.max_len)
+            prompt_op, _ = server._pad_rows([row[:base]], [base], 1, base)
+            suffix_op, _ = server._pad_rows([row[base:]], [sbs], 1, sbs)
+            outs = []
+            for sp in (0, 2):
+                cache = pf(server.params, prompt_op, jnp.int32(base))
+                outs.append(_continue_prefill(
+                    server.model, server.params, cache, suffix_op,
+                    jnp.int32(sbs), select, keys0,
+                    eos_op, sbs, pos_offset=jnp.int32(off),
+                    sp_prefill=sp))
+        (f0, lp0s, c0, s0, _, _), (f1, lp1s, c1, s1, _, _) = outs
+        assert int(np.asarray(f0[0])) == int(np.asarray(f1[0])), \
+            f"first token diverged at pos_offset={off}"
+        np.testing.assert_allclose(np.asarray(lp0s), np.asarray(lp1s),
+                                   rtol=5e-4, atol=5e-4)
+        assert np.array_equal(np.asarray(s0), np.asarray(s1))
+        for a, b in zip(_cache_kv(c0, base + sbs),
+                        _cache_kv(c1, base + sbs)):
+            np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-4)
+
+
+def test_lsp_round_scatters_into_shuffled_pages(sp_server):
+    """The paged sp round writes each shard's KV through the arena
+    page tables: a SHUFFLED (non-contiguous) table must land the same
+    bytes a fresh dense prefill computes, distractor pages holding
+    garbage must come back bitwise untouched, and the null fill slots
+    of round 0 must leave the null page bitwise unchanged."""
+    from lambdipy_tpu.models.llama import (
+        arena_page_slices,
+        init_page_arena,
+    )
+    from lambdipy_tpu.runtime.pagepool import NULL_PAGE
+
+    server = sp_server
+    cfg = server.model.cfg
+    page, window, sp = 16, 32, 2
+    rbs = sp * (window // 2)   # 32-token round, 2 pages
+    n_pages = 8
+    rng = np.random.default_rng(13)
+    row = rng.integers(5, cfg.vocab_size - 5, size=rbs).tolist()
+
+    def _page_bytes(arena, pid):
+        return b"".join(np.asarray(x).tobytes()
+                        for entry in arena_page_slices(arena, pid, page)
+                        for x in entry.values())
+
+    with server._mesh_ctx():
+        arena = init_page_arena(cfg, n_pages, page, mesh=server.mesh)
+        # salt every page so an accidental write is visible
+        write = server._page_write_fn(n_pages, page)
+        for pid in range(n_pages):
+            salt = [{n: jnp.asarray(rng.normal(size=np.asarray(x).shape),
+                                    np.asarray(x).dtype)
+                     for n, x in entry.items()}
+                    for entry in arena_page_slices(arena, pid, page)]
+            arena = write(arena, jnp.int32(pid), salt)
+        before = {pid: _page_bytes(arena, pid) for pid in range(n_pages)}
+        # shuffled, non-contiguous round pages + the round-0 null fill
+        table = [5, 2, NULL_PAGE]
+        rnd = server._lsp_round_fn(sp, n_pages, page, window, sp)
+        suffix_op, _ = server._pad_rows([row], [rbs], 1, rbs)
+        knobs = server._knob_operands(0.0, None, None, 0, None, b=1)
+        t_op, k_op, p_op, keys0, eos_op = knobs
+        first, lp0, arena, start, done, _ = rnd(
+            server.params, arena, jnp.asarray(table, jnp.int32)[None, :],
+            jnp.int32(0), jnp.int32(0), suffix_op, jnp.int32(rbs),
+            t_op, k_op, p_op, keys0, eos_op)
+        # oracle: the same tokens through the dense serve prefill
+        ref_cache = server._chunked_prefill_cache(row, rbs, cfg.max_len)
+        gather = server._paged_gather_fn(n_pages, page, rbs)
+        got = gather(arena, jnp.asarray(table[:2], jnp.int32)[None, :],
+                     jnp.int32(rbs))
+    assert int(np.asarray(start)[0]) == rbs
+    for a, b in zip(_cache_kv(ref_cache, rbs), _cache_kv(got, rbs)):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-4)
+    # distractor pages (garbage) and the null page: bitwise untouched
+    for pid in (0, 1, 3, 4, 6, 7):
+        assert _page_bytes(arena, pid) == before[pid], \
+            f"page {pid} was touched by the sp round scatter"
+
+
+# -- the long-context runner: sp rounds vs the serial slide chain ------------
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+def test_longctx_sp_rounds_match_serial_chain(sp_server, sampled):
+    """ceil(S/(sp*w2)) sharded rounds == the serial window/2 slide
+    chain, token for token, greedy AND seeded-sampled, with a ragged
+    final round and a multi-slide prompt."""
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+    from lambdipy_tpu.runtime.metrics import PrefillStats
+
+    from tests.test_long_context import mk_pool
+
+    server = sp_server
+    cfg = server.model.cfg
+    rng = np.random.default_rng(17)
+    window = 64
+    s = 3 * window + window // 2 + 5   # ragged last round at sp=2
+    row = rng.integers(5, cfg.vocab_size - 5, size=s).tolist()
+    kw = dict(window=window, segment=8, max_logical_ctx=16 * window)
+    knobs = (dict(temperature=0.8, top_k=20, seed=5)
+             if sampled else dict(temperature=0.0, seed=0))
+    serial = LongContextRunner(server, mk_pool(server), **kw).generate(
+        row, max_new_tokens=10, **knobs)
+    stats = PrefillStats()
+    stats.configure("sp", 2)
+    pool = mk_pool(server, extra_pages=4)
+    runner = LongContextRunner(server, pool, prefill_mode="sp",
+                               prefill_stats=stats, **kw)
+    sharded = runner.generate(row, max_new_tokens=10, **knobs)
+    assert np.array_equal(np.asarray(serial), np.asarray(sharded)), \
+        f"sampled={sampled}: sp rounds diverged from the serial chain"
+    rep = stats.report()
+    assert rep["rounds"] == -(-s // window)  # rbs = sp * w2 = window
+    assert rep["sharded_chunks"] > 0
+    # every page the runner took went back to the pool
+    assert pool.free_count() == pool.capacity_pages
+
+
+def test_longctx_sp_ragged_tail_releases_pages(sp_server):
+    """A ragged FINAL round whose decode view starts exactly at the
+    carried history (base == gs) leaves union pages past the view —
+    pure padding (tokens >= s). They must go back to the pool, not
+    leak: the geometry s = 3*window - window/2 pins off0 == 0 with a
+    2-page tail."""
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+
+    from tests.test_long_context import mk_pool
+
+    server = sp_server
+    cfg = server.model.cfg
+    rng = np.random.default_rng(29)
+    window = 64
+    s = 3 * window - window // 2   # last round: 32 of 64 tokens real
+    row = rng.integers(5, cfg.vocab_size - 5, size=s).tolist()
+    kw = dict(window=window, segment=8, max_logical_ctx=8 * window)
+    serial = LongContextRunner(server, mk_pool(server), **kw).generate(
+        row, max_new_tokens=8, temperature=0.0)
+    pool = mk_pool(server, extra_pages=4)
+    sharded = LongContextRunner(server, pool, prefill_mode="sp",
+                                **kw).generate(
+        row, max_new_tokens=8, temperature=0.0)
+    assert np.array_equal(np.asarray(serial), np.asarray(sharded))
+    assert pool.free_count() == pool.capacity_pages, \
+        "ragged-tail union pages leaked from the pool"
+
+
+def test_longctx_sp_within_window_prompt(sp_server):
+    """A prompt over one chunk but under the window: ONE sp round, no
+    slide, same tokens as serial — the small-prompt edge of the round
+    schedule (and the serial fallback below the gate)."""
+    from lambdipy_tpu.runtime.longctx import LongContextRunner
+
+    from tests.test_long_context import mk_pool
+
+    server = sp_server
+    cfg = server.model.cfg
+    rng = np.random.default_rng(19)
+    window = 64
+    row = rng.integers(5, cfg.vocab_size - 5, size=window - 7).tolist()
+    kw = dict(window=window, segment=8, max_logical_ctx=8 * window)
+    serial = LongContextRunner(server, mk_pool(server), **kw).generate(
+        row, max_new_tokens=8, temperature=0.0)
+    sharded = LongContextRunner(server, mk_pool(server, extra_pages=4),
+                                prefill_mode="sp", **kw).generate(
+        row, max_new_tokens=8, temperature=0.0)
+    assert np.array_equal(np.asarray(serial), np.asarray(sharded))
+
+
+# -- stand-downs: counted, never silent --------------------------------------
+
+
+def test_sp_prefill_without_mesh_stands_down():
+    from lambdipy_tpu.parallel import spdecode
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    spdecode._reset_standdowns_for_tests()
+    adapter = registry.get("llama-tiny").build()
+    server = adapter.make_server(adapter.init_params(seed=0))
+    cb = ContinuousBatcher(server, slots=2, segment=4,
+                           prefill_mode="sp")
+    assert cb.prefill_sp == 0
+    assert cb.prefill_mode == "sp"  # the ask is remembered...
+    reasons = spdecode.standdown_stats()["reasons"]
+    assert reasons.get("sp_prefill_without_sp_mesh", 0) >= 1
+    rep = cb.stats()["prefill"]
+    assert rep["mode"] == "sp" and rep["sp"] == 0
+    assert rep["standdowns"].get("sp_prefill_without_sp_mesh") == 1
+
+
+def test_resolve_sp_prefill_modes(cpu_devices):
+    assert resolve_sp_prefill("chunked", None) == 0
+    mesh = make_mesh({"sp": 2}, devices=cpu_devices[:2])
+    assert resolve_sp_prefill("chunked", mesh) == 0
+    assert resolve_sp_prefill("sp", mesh) == 2
+    tp = make_mesh({"tp": 2}, devices=cpu_devices[:2])
+    from lambdipy_tpu.parallel import spdecode
+
+    spdecode._reset_standdowns_for_tests()
+    assert resolve_sp_prefill("sp", tp) == 0
+    assert spdecode.standdown_stats()["reasons"][
+        "sp_prefill_without_sp_mesh"] == 1
+
+
+def test_engine_sp_prefill_matches_chunked_engine(sp_server):
+    """The continuous engine end to end: cold rows prefilled under
+    prefill_mode="sp" must emit the same tokens the chunked engine
+    emits — group prefill and the long-row chunked joiner both route
+    through the sharded programs."""
+    from lambdipy_tpu.runtime.continuous import ContinuousBatcher
+
+    server = sp_server
+    cfg = server.model.cfg
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(5, cfg.vocab_size - 5, size=n).tolist()
+               for n in (24, 40, 96)]
+
+    def run(mode):
+        from concurrent.futures import ThreadPoolExecutor
+
+        cb = ContinuousBatcher(server, slots=2, segment=8,
+                               prefill_mode=mode)
+        with ThreadPoolExecutor(max_workers=len(prompts)) as ex:
+            futs = [ex.submit(cb.generate, p, max_new_tokens=8,
+                              temperature=0.0) for p in prompts]
+            return [f.result() for f in futs]
+
+    chunked, sharded = run("chunked"), run("sp")
+    for a, b in zip(chunked, sharded):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # the sp engine actually sharded something, visibly
+    cb = ContinuousBatcher(server, slots=2, segment=8,
+                           prefill_mode="sp")
+    assert cb.prefill_sp == 2
+    assert cb.stats()["prefill"]["mode"] == "sp"
